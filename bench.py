@@ -1,0 +1,101 @@
+"""Benchmark: fused metric-step throughput on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Config 1 of BASELINE.md: Accuracy (10-class) + StatScores in a MetricCollection.
+The baseline proxy is a faithful torch-CPU implementation of the same
+accumulation (the reference publishes no numbers — BASELINE.md), timed in-process.
+"""
+import json
+import time
+
+import numpy as np
+
+BATCH = 2048
+NUM_CLASSES = 10
+STEPS = 50
+
+
+def bench_ours() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, MetricCollection, StatScores
+
+    mc = MetricCollection(
+        {"acc": Accuracy(num_classes=NUM_CLASSES), "stats": StatScores(reduce="macro", num_classes=NUM_CLASSES)}
+    )
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, (BATCH,)))
+
+    @jax.jit
+    def step(state, p, t):
+        return mc.pure_update(state, p, t)
+
+    state = mc.init_state()
+    state = step(state, preds, target)  # compile
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state = step(state, preds, target)
+    jax.block_until_ready(state)
+    dt = (time.perf_counter() - t0) / STEPS
+    # sanity: value must be finite
+    vals = mc.pure_compute(state)
+    assert np.isfinite(float(np.asarray(vals["acc"]))), "bench produced non-finite metric"
+    return dt
+
+
+def bench_torch_baseline() -> float:
+    """Reference-style accumulation in torch (CPU), same math, same shapes."""
+    import torch
+
+    rng = np.random.RandomState(0)
+    preds = torch.from_numpy(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
+    target = torch.from_numpy(rng.randint(0, NUM_CLASSES, (BATCH,)))
+
+    def step(tp, fp, tn, fn, correct, total):
+        p1 = preds.argmax(1)
+        oh_p = torch.nn.functional.one_hot(p1, NUM_CLASSES)
+        oh_t = torch.nn.functional.one_hot(target, NUM_CLASSES)
+        true_pred = oh_t == oh_p
+        pos_pred = oh_p == 1
+        tp = tp + (true_pred & pos_pred).sum(0)
+        fp = fp + (~true_pred & pos_pred).sum(0)
+        tn = tn + (true_pred & ~pos_pred).sum(0)
+        fn = fn + (~true_pred & ~pos_pred).sum(0)
+        correct = correct + (p1 == target).sum()
+        total = total + target.numel()
+        return tp, fp, tn, fn, correct, total
+
+    z = torch.zeros(NUM_CLASSES, dtype=torch.long)
+    st = (z, z.clone(), z.clone(), z.clone(), torch.zeros((), dtype=torch.long), 0)
+    st = step(*st)  # warm
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        st = step(*st)
+    return (time.perf_counter() - t0) / STEPS
+
+
+def main() -> None:
+    ours = bench_ours()
+    try:
+        base = bench_torch_baseline()
+        vs = base / ours
+    except Exception:
+        vs = None
+    print(
+        json.dumps(
+            {
+                "metric": "fused_metric_step_time",
+                "value": round(ours * 1e6, 2),
+                "unit": "us/step",
+                "vs_baseline": round(vs, 3) if vs else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

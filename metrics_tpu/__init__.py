@@ -1,0 +1,26 @@
+"""metrics_tpu — a TPU-native metrics framework.
+
+Stateful, batch-accumulating, distributed-synchronizing metric computation for
+JAX: the capabilities of TorchMetrics (reference at ``/root/reference``),
+re-designed around pytree states, jit-fused update+sync+compute steps, and
+XLA collectives over device meshes.
+"""
+import logging
+
+_logger = logging.getLogger("metrics_tpu")
+_logger.addHandler(logging.StreamHandler())
+_logger.setLevel(logging.INFO)
+
+__version__ = "0.1.0"
+
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import CompositionalMetric, Metric
+from metrics_tpu.classification import Accuracy, StatScores
+
+__all__ = [
+    "Accuracy",
+    "CompositionalMetric",
+    "Metric",
+    "MetricCollection",
+    "StatScores",
+]

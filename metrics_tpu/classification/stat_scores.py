@@ -1,0 +1,111 @@
+"""StatScores module metric — stateful tp/fp/tn/fn accumulator.
+
+Behavioral analogue of the reference's
+``torchmetrics/classification/stat_scores.py:43-271``. States are sum-reduced
+int32 leaves (``psum`` across the mesh) unless ``reduce='samples'`` /
+``mdmc_reduce='samplewise'``, which accumulate per-batch arrays as "cat" list
+states (``all_gather`` across the mesh), mirroring reference
+``stat_scores.py:178-191``.
+"""
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.stat_scores import (
+    _stat_scores_compute,
+    _stat_scores_update,
+)
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class StatScores(Metric):
+    """Computes the number of true/false positives/negatives and support."""
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        top_k: Optional[int] = None,
+        reduce: str = "micro",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        mdmc_reduce: Optional[str] = None,
+        multiclass: Optional[bool] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.reduce = reduce
+        self.mdmc_reduce = mdmc_reduce
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.multiclass = multiclass
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+
+        if reduce not in ["micro", "macro", "samples"]:
+            raise ValueError(f"The `reduce` {reduce} is not valid.")
+        if mdmc_reduce not in [None, "samplewise", "global"]:
+            raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+        if reduce == "macro" and (not num_classes or num_classes < 1):
+            raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+        if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+        default: Any
+        if mdmc_reduce != "samplewise" and reduce != "samples":
+            zeros_shape = () if reduce == "micro" else (num_classes,)
+            default, reduce_fn = jnp.zeros(zeros_shape, dtype=jnp.int32), "sum"
+        else:
+            default, reduce_fn = [], None
+
+        for s in ("tp", "fp", "tn", "fn"):
+            self.add_state(s, default=[] if isinstance(default, list) else default, dist_reduce_fx=reduce_fn)
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        """Accumulate tp/fp/tn/fn from a batch of (preds, target)."""
+        tp, fp, tn, fn = _stat_scores_update(
+            preds,
+            target,
+            reduce=self.reduce,
+            mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold,
+            num_classes=self.num_classes,
+            top_k=self.top_k,
+            multiclass=self.multiclass,
+            ignore_index=self.ignore_index,
+        )
+        if isinstance(self.tp, list):
+            self.tp.append(tp)
+            self.fp.append(fp)
+            self.tn.append(tn)
+            self.fn.append(fn)
+        else:
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
+
+    def _get_final_stats(self) -> Tuple[Array, Array, Array, Array]:
+        """Concatenate list states (samplewise) or pass through sum states."""
+        if isinstance(self.tp, list):
+            return (
+                dim_zero_cat(self.tp),
+                dim_zero_cat(self.fp),
+                dim_zero_cat(self.tn),
+                dim_zero_cat(self.fn),
+            )
+        return self.tp, self.fp, self.tn, self.fn
+
+    def compute(self) -> Array:
+        """Return the ``(..., 5)`` array of ``[tp, fp, tn, fn, support]``."""
+        tp, fp, tn, fn = self._get_final_stats()
+        return _stat_scores_compute(tp, fp, tn, fn)

@@ -1,0 +1,181 @@
+"""MetricCollection — many metrics, one update call, one fused sync.
+
+Behavioral analogue of the reference's ``torchmetrics/collections.py:26-235``.
+TPU upgrade: :meth:`pure_forward` traces *all* member metrics' update + sync +
+compute into a single XLA program, so a collection costs one fused reduction
+over the mesh instead of one gather per metric (the BASELINE north star).
+"""
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+from metrics_tpu.core.metric import Metric
+
+
+class MetricCollection(dict):
+    """An ordered dict of metrics sharing a single ``update``/``forward`` call.
+
+    Args:
+        metrics: one Metric, a list/tuple of Metrics, or a dict name->Metric.
+        prefix / postfix: added to every key in the output dict.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self.add_metrics(metrics, *additional_metrics)
+
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def add_metrics(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+    ) -> None:
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                raise ValueError(
+                    f"You have passes extra arguments {remain} which are not `Metric` so they will be ignored."
+                )
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of `Metric` or `MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of `Metric` or `MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    name = type(metric).__name__
+                    if name in self:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self[k] = v
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def items(self, keep_base: bool = False) -> Iterable[Tuple[str, Metric]]:  # type: ignore[override]
+        if keep_base:
+            return super().items()
+        return [(self._set_name(k), v) for k, v in super().items()]
+
+    def keys(self, keep_base: bool = False) -> Iterable[str]:  # type: ignore[override]
+        if keep_base:
+            return super().keys()
+        return [self._set_name(k) for k in super().keys()]
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return {
+            self._set_name(k): m(*args, **m._filter_kwargs(**kwargs))
+            for k, m in super().items()
+        }
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
+        for m in self.values():
+            m.update(*args, **m._filter_kwargs(**kwargs))
+
+    def compute(self) -> Dict[str, Any]:
+        return {self._set_name(k): m.compute() for k, m in super().items()}
+
+    def reset(self) -> None:
+        for m in self.values():
+            m.reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self.values():
+            m.persistent(mode)
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, m in super().items():
+            out.update(m.state_dict(prefix=f"{k}."))
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        for k, m in super().items():
+            m.load_state_dict(state_dict, prefix=f"{k}.")
+
+    # ---------------- pure-functional fused path ----------------
+
+    def init_state(self) -> Dict[str, Dict[str, Any]]:
+        return {k: m.init_state() for k, m in super().items()}
+
+    def pure_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return {
+            k: m.pure_update(state[k], *args, **m._filter_kwargs(**kwargs))
+            for k, m in super().items()
+        }
+
+    def pure_sync(self, state: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+        return {k: m.pure_sync(state[k], axis_name) for k, m in super().items()}
+
+    def pure_compute(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        return {self._set_name(k): m.pure_compute(state[k]) for k, m in super().items()}
+
+    def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+        return {k: m.merge_states(a[k], b[k]) for k, m in super().items()}
+
+    def pure_forward(
+        self, state: Dict[str, Any], *args: Any, axis_name: Optional[str] = None, **kwargs: Any
+    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """One fused jittable step for the WHOLE collection: all member
+        updates, one round of collectives, all computes — a single XLA graph."""
+        batch = self.pure_update(self.init_state(), *args, **kwargs)
+        value_state = self.pure_sync(batch, axis_name) if axis_name else batch
+        values = self.pure_compute(value_state)
+        new_state = self.merge_states(state, batch)
+        return new_state, values
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "(\n"
+        for k, v in super().items():
+            repr_str += f"  ({k}): {repr(v)}\n"
+        return repr_str + ")"

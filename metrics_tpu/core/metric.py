@@ -1,0 +1,728 @@
+"""The stateful ``Metric`` base class — TPU-native core runtime.
+
+Behavioral analogue of the reference's ``torchmetrics/metric.py:38-715``,
+re-designed around JAX's functional model:
+
+- **State is a pytree**, not module buffers: ``add_state`` (reference
+  ``metric.py:112``) registers a named leaf (jnp array, or python list of
+  arrays for "cat" states) plus its cross-device reduction.
+- **Dual API.** The torchmetrics-style stateful surface (``update`` mutates
+  declared attributes, ``compute`` reads them) is a thin shell over pure
+  functions: :meth:`pure_update`, :meth:`pure_compute`, :meth:`pure_sync` and
+  :meth:`merge_states` thread an explicit state dict and are jit/shard_map
+  compatible — the whole update+sync+compute pipeline traces into ONE XLA
+  program (the reference needs a post-hoc ``all_gather`` per state instead,
+  ``metric.py:217-242``).
+- **``forward()`` without the double-update tax.** The reference runs
+  ``update`` twice per step when ``compute_on_step=True``
+  (``metric.py:190-204``). Here ``forward`` runs ``update`` once on a fresh
+  state, computes the batch-local value from it, and *merges* it into the
+  accumulated state — falling back to the reference's semantics only for
+  states whose reduction has no algebraic merge.
+- **Sync state machine** (``_is_synced`` with guarded transitions raising
+  on double-sync / unsync-without-sync / update-while-synced) mirrors
+  reference ``metric.py:184-188,271-272,299-303``.
+"""
+import functools
+from copy import deepcopy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.parallel.sync import (
+    host_sync_state,
+    jit_distributed_available,
+    sync_in_jit,
+)
+from metrics_tpu.utils.data import apply_to_collection
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.utils.prints import rank_zero_warn
+
+_MERGEABLE_FX = ("sum", "cat", "max", "min")
+
+
+def _copy_state_value(v: Any) -> Any:
+    return list(v) if isinstance(v, list) else v
+
+
+class Metric:
+    """Base class for all metrics.
+
+    Args:
+        compute_on_step: return the metric value for the current batch from
+            ``forward`` (reference ``metric.py:73``).
+        dist_sync_on_step: synchronize state across devices/processes when
+            computing the per-step value (reference ``metric.py:75``).
+        process_group: unused placeholder kept for API parity; JAX collectives
+            run over all processes (or a named mesh axis via ``pure_sync``).
+        dist_sync_fn: custom callable ``(state_dict, reductions) -> state_dict``
+            replacing the built-in host sync — the seam integrations use
+            (reference ``metric.py:78``).
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        # bypass custom __setattr__ while bootstrapping
+        object.__setattr__(self, "_state", {})
+        object.__setattr__(self, "_defaults", {})
+        self._reductions: Dict[str, Any] = {}
+        self._persistent: Dict[str, bool] = {}
+        self.compute_on_step = compute_on_step
+        self.dist_sync_on_step = dist_sync_on_step
+        self.process_group = process_group
+        self.dist_sync_fn = dist_sync_fn
+        self._update_called = False
+        self._computed: Any = None
+        self._forward_cache: Any = None
+        self._to_sync = True
+        self._is_synced = False
+        self._cache: Optional[Dict[str, Any]] = None
+        self._dtype: Any = None
+
+    # ------------------------------------------------------------------
+    # state declaration & attribute routing
+    # ------------------------------------------------------------------
+
+    def add_state(
+        self,
+        name: str,
+        default: Union[Array, list],
+        dist_reduce_fx: Union[str, Callable, None] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a named state leaf with its cross-device reduction.
+
+        Analogue of reference ``metric.py:112-176``. ``default`` must be a jnp
+        array (reset value) or an empty list (a "cat" state that accumulates
+        per-batch arrays). ``dist_reduce_fx`` ∈ {'sum','mean','cat','max','min',
+        None, callable}: determines both the cross-device reduction and (where
+        algebraically possible) the merge used by ``forward``/checkpoint resume.
+        """
+        if isinstance(default, list):
+            if default:
+                raise ValueError("state variable must be a jnp array or an empty list")
+        elif not (hasattr(default, "shape") or isinstance(default, (int, float))):
+            raise ValueError("state variable must be a jnp array or an empty list")
+        if dist_reduce_fx is not None and not (
+            dist_reduce_fx in ("sum", "mean", "cat", "max", "min") or callable(dist_reduce_fx)
+        ):
+            raise ValueError(
+                "`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'max', 'min', None]"
+            )
+        if not isinstance(default, list):
+            default = jnp.asarray(default)
+        self._defaults[name] = _copy_state_value(default)
+        self._reductions[name] = dist_reduce_fx
+        self._persistent[name] = persistent
+        self._state[name] = _copy_state_value(default)
+
+    def __getattr__(self, name: str) -> Any:
+        # only called when normal lookup fails
+        state = object.__getattribute__(self, "__dict__").get("_state")
+        if state is not None and name in state:
+            return state[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        state = self.__dict__.get("_state")
+        if state is not None and name in state:
+            state[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    @property
+    def state_names(self) -> List[str]:
+        return list(self._defaults)
+
+    # ------------------------------------------------------------------
+    # stateful API (torchmetrics-compatible shell)
+    # ------------------------------------------------------------------
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Accumulate the batch into state and return the batch-local value.
+
+        Single-update + merge where the state algebra allows (see module
+        docstring); exact reference semantics (``metric.py:178-215``) otherwise.
+        """
+        if self._is_synced:
+            raise MetricsTPUUserError(
+                "The Metric shouldn't be synced when performing ``update``. "
+                "HINT: Did you forget to call ``unsync``?"
+            )
+        if not self.compute_on_step:
+            self.update(*args, **kwargs)
+            return None
+
+        accumulated = {k: _copy_state_value(v) for k, v in self._state.items()}
+        update_count_supported = self._can_merge()
+        # fresh state -> batch state
+        self._restore(self._default_state())
+        self.update(*args, **kwargs)
+        batch_state = {k: _copy_state_value(v) for k, v in self._state.items()}
+
+        # batch-local value; the compute wrapper dist-syncs only if
+        # dist_sync_on_step (reference metric.py:194,364 gates on _to_sync)
+        self._to_sync = self.dist_sync_on_step
+        self._computed = None
+        try:
+            self._forward_cache = self.compute()
+        finally:
+            self._to_sync = True
+        self._computed = None
+        # the wrapper's sync_context restored the (unsynced) batch state
+        batch_state = {k: _copy_state_value(v) for k, v in self._state.items()}
+
+        if update_count_supported:
+            merged = self.merge_states(accumulated, batch_state)
+            self._restore(merged)
+        else:
+            # non-mergeable state: replay the reference's double-update path
+            self._restore(accumulated)
+            self.update(*args, **kwargs)
+        return self._forward_cache
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # noqa: D102 - abstract
+        raise NotImplementedError(
+            f"Metric {type(self).__name__} must implement `update`."
+        )
+
+    def compute(self) -> Any:  # noqa: D102 - abstract
+        raise NotImplementedError(
+            f"Metric {type(self).__name__} must implement `compute`."
+        )
+
+    def __init_subclass__(cls, **kwargs: Any) -> None:
+        super().__init_subclass__(**kwargs)
+        # wrap update/compute once per subclass (reference _wrap_update /
+        # _wrap_compute, metric.py:244-251,345-370)
+        if "update" in cls.__dict__ and not getattr(cls.update, "_wrapped", False):
+            cls.update = _wrap_update(cls.update)
+        if "compute" in cls.__dict__ and not getattr(cls.compute, "_wrapped", False):
+            cls.compute = _wrap_compute(cls.compute)
+
+    # ------------------------------------------------------------------
+    # sync machinery
+    # ------------------------------------------------------------------
+
+    def _run_dist_sync(self, state: Dict[str, Any]) -> Dict[str, Any]:
+        fn = self.dist_sync_fn
+        if fn is not None:
+            return fn(state, self._reductions)
+        return host_sync_state(state, self._reductions)
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Callable] = None,
+    ) -> None:
+        """Synchronize state across processes (host path); caches local state.
+
+        Analogue of reference ``metric.py:253-287``.
+        """
+        if self._is_synced and should_sync:
+            raise MetricsTPUUserError("The Metric has already been synced.")
+        is_distributed = (
+            distributed_available() if distributed_available is not None else jit_distributed_available()
+        )
+        if not should_sync or not is_distributed:
+            return
+        self._cache = {k: _copy_state_value(v) for k, v in self._state.items()}
+        fn = dist_sync_fn or self.dist_sync_fn
+        if fn is not None:
+            synced = fn(self._cache, self._reductions)
+        else:
+            synced = host_sync_state(self._cache, self._reductions)
+        self._restore(synced)
+        self._is_synced = True
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore the pre-sync local state (reference ``metric.py:289-309``)."""
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise MetricsTPUUserError("The Metric has already been un-synced.")
+        if self._cache is None:
+            raise MetricsTPUUserError("The internal cache should exist to unsync the Metric.")
+        self._restore(self._cache)
+        self._is_synced = False
+        self._cache = None
+
+    class _SyncContext:
+        def __init__(self, metric: "Metric", **kwargs: Any) -> None:
+            self.metric = metric
+            self.kwargs = kwargs
+            self.should_unsync = kwargs.pop("should_unsync", True)
+
+        def __enter__(self) -> "Metric":
+            self.metric.sync(**self.kwargs)
+            return self.metric
+
+        def __exit__(self, *exc: Any) -> None:
+            self.metric.unsync(should_unsync=self.metric._is_synced and self.should_unsync)
+
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available: Optional[Callable] = None,
+    ) -> "Metric._SyncContext":
+        """Context manager: sync on enter, restore local state on exit.
+
+        Analogue of reference ``metric.py:311-343``; the documented pattern for
+        consistent checkpoints (sync → state_dict → unsync).
+        """
+        return Metric._SyncContext(
+            self,
+            dist_sync_fn=dist_sync_fn,
+            should_sync=should_sync,
+            should_unsync=should_unsync,
+            distributed_available=distributed_available,
+        )
+
+    # ------------------------------------------------------------------
+    # pure-functional API (jit / shard_map)
+    # ------------------------------------------------------------------
+
+    def init_state(self) -> Dict[str, Any]:
+        """A fresh state pytree (the declared defaults)."""
+        return self._default_state()
+
+    def pure_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Pure functional update: ``state -> new state``. jit-compatible for
+        fixed-shape (non-list) states."""
+        saved = self._state
+        self._state = {k: _copy_state_value(v) for k, v in state.items()}
+        try:
+            self.update(*args, **kwargs)
+            return self._state
+        finally:
+            self._state = saved
+
+    def pure_compute(self, state: Dict[str, Any]) -> Any:
+        """Pure functional compute over an explicit state pytree."""
+        saved, saved_computed = self._state, self._computed
+        self._state = {k: _copy_state_value(v) for k, v in state.items()}
+        self._computed = None
+        try:
+            return self.compute()
+        finally:
+            self._state, self._computed = saved, saved_computed
+
+    def pure_sync(self, state: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+        """In-jit cross-device sync over a named mesh axis (psum/all_gather)."""
+        return sync_in_jit(state, self._reductions, axis_name)
+
+    def pure_forward(
+        self, state: Dict[str, Any], *args: Any, axis_name: Optional[str] = None, **kwargs: Any
+    ) -> Any:
+        """One fused step: ``(new_state, batch_value)``; sync if ``axis_name``.
+
+        This is the jittable hot path: update + (optional) collective sync +
+        compute trace into a single XLA program.
+        """
+        batch_state = self.pure_update(self.init_state(), *args, **kwargs)
+        value_state = self.pure_sync(batch_state, axis_name) if axis_name else batch_state
+        value = self.pure_compute(value_state)
+        new_state = self.merge_states(state, batch_state)
+        return new_state, value
+
+    # ------------------------------------------------------------------
+    # merge / reset / persistence
+    # ------------------------------------------------------------------
+
+    def _can_merge(self) -> bool:
+        if type(self).merge_states is not Metric.merge_states:
+            return True
+        return all(
+            fx in _MERGEABLE_FX or isinstance(self._defaults[name], list)
+            for name, fx in self._reductions.items()
+        )
+
+    def merge_states(self, state_a: Dict[str, Any], state_b: Dict[str, Any]) -> Dict[str, Any]:
+        """Merge two accumulated states of this metric into one.
+
+        Defined by each state's reduction: sum→add, cat→concat, max/min→
+        elementwise. Subclasses with running-moment states (e.g. Pearson)
+        override this with their pairwise-merge formula. Used by ``forward``,
+        checkpoint resume, and map-reduce style eval sharding.
+        """
+        out: Dict[str, Any] = {}
+        for name, fx in self._reductions.items():
+            a, b = state_a[name], state_b[name]
+            if isinstance(self._defaults[name], list):
+                out[name] = list(a) + list(b)
+            elif fx == "sum":
+                out[name] = a + b
+            elif fx == "max":
+                out[name] = jnp.maximum(a, b)
+            elif fx == "min":
+                out[name] = jnp.minimum(a, b)
+            elif fx == "cat":
+                out[name] = jnp.concatenate([jnp.atleast_1d(a), jnp.atleast_1d(b)], axis=0)
+            else:
+                raise MetricsTPUUserError(
+                    f"State {name!r} with reduction {fx!r} has no algebraic merge; "
+                    f"override `merge_states` in {type(self).__name__}."
+                )
+        return out
+
+    def merge_state(self, incoming: Union["Metric", Dict[str, Any]]) -> None:
+        """Merge another metric's (or raw state dict's) accumulation into self."""
+        other = incoming._state if isinstance(incoming, Metric) else incoming
+        self._restore(self.merge_states(self._state, other))
+
+    def _default_state(self) -> Dict[str, Any]:
+        return {k: _copy_state_value(v) for k, v in self._defaults.items()}
+
+    def _restore(self, state: Dict[str, Any]) -> None:
+        for k, v in state.items():
+            self._state[k] = _copy_state_value(v)
+
+    def reset(self) -> None:
+        """Reset state to defaults (reference ``metric.py:381-398``)."""
+        self._update_called = False
+        self._forward_cache = None
+        self._computed = None
+        self._restore(self._default_state())
+        self._is_synced = False
+        self._cache = None
+
+    def clone(self) -> "Metric":
+        """Deep copy (reference ``metric.py:400``)."""
+        return deepcopy(self)
+
+    def __deepcopy__(self, memo: dict) -> "Metric":
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for k, v in self.__dict__.items():
+            object.__setattr__(new, k, deepcopy(v, memo))
+        return new
+
+    # ------------------------------------------------------------------
+    # serialization / device & dtype management
+    # ------------------------------------------------------------------
+
+    def persistent(self, mode: bool = False) -> None:
+        for name in self._persistent:
+            self._persistent[name] = mode
+
+    def state_dict(self, prefix: str = "") -> Dict[str, Any]:
+        """Host-side snapshot of persistent states (numpy leaves)."""
+        out: Dict[str, Any] = {}
+        for name in self._defaults:
+            if not self._persistent[name]:
+                continue
+            v = self._state[name]
+            out[prefix + name] = (
+                [np.asarray(x) for x in v] if isinstance(v, list) else np.asarray(v)
+            )
+        return out
+
+    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
+        for name in self._defaults:
+            key = prefix + name
+            if key in state_dict:
+                v = state_dict[key]
+                self._state[name] = (
+                    [jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)
+                )
+                self._update_called = True
+
+    def to_device(self, device: Any) -> "Metric":
+        """Move all array state to ``device`` (analogue of ``.to()``)."""
+        self._restore(
+            apply_to_collection(self._state, (jnp.ndarray,), lambda x: jax.device_put(x, device))
+        )
+        return self
+
+    def set_dtype(self, dtype: Any) -> "Metric":
+        """Cast floating state leaves (analogue of reference ``metric.py:504``)."""
+
+        def cast(x: Array) -> Array:
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                return x.astype(dtype)
+            return x
+
+        self._dtype = dtype
+        self._restore(apply_to_collection(self._state, (jnp.ndarray,), cast))
+        self._defaults = apply_to_collection(self._defaults, (jnp.ndarray,), cast)
+        return self
+
+    # pickling: jnp arrays pickle via numpy
+    def __getstate__(self) -> Dict[str, Any]:
+        state = {k: v for k, v in self.__dict__.items() if k != "update" and k != "compute"}
+        state["_state"] = apply_to_collection(self._state, (jnp.ndarray,), np.asarray)
+        state["_defaults"] = apply_to_collection(self._defaults, (jnp.ndarray,), np.asarray)
+        state["_cache"] = apply_to_collection(self._cache, (jnp.ndarray,), np.asarray)
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        self._state = apply_to_collection(self._state, (np.ndarray,), jnp.asarray)
+        self._defaults = apply_to_collection(self._defaults, (np.ndarray,), jnp.asarray)
+        self._cache = apply_to_collection(self._cache, (np.ndarray,), jnp.asarray)
+
+    def __hash__(self) -> int:
+        hash_vals = [type(self).__name__]
+        for name in self._defaults:
+            v = self._state[name]
+            if isinstance(v, list):
+                hash_vals.extend(np.asarray(x).tobytes() for x in v)
+            else:
+                hash_vals.append(np.asarray(v).tobytes())
+        return hash(tuple(hash_vals))
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Keep only kwargs accepted by this metric's ``update`` signature.
+
+        Analogue of reference ``metric.py:583-604``; lets ``MetricCollection``
+        broadcast a superset of kwargs to heterogeneous metrics. The signature
+        is inspected once per instance (hot path: every collection step).
+        """
+        names = self.__dict__.get("_update_kwarg_names")
+        if names is None:
+            import inspect
+
+            params = inspect.signature(self.update).parameters
+            has_var_kw = any(p.kind == p.VAR_KEYWORD for p in params.values())
+            names = True if has_var_kw else frozenset(params)
+            object.__setattr__(self, "_update_kwarg_names", names)
+        if names is True:
+            return kwargs
+        return {k: v for k, v in kwargs.items() if k in names}
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+    # ------------------------------------------------------------------
+    # operator composition (reference metric.py:606-709)
+    # ------------------------------------------------------------------
+
+    def __add__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, self, other)
+
+    def __radd__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.add, other, self)
+
+    def __sub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, self, other)
+
+    def __rsub__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.subtract, other, self)
+
+    def __mul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, self, other)
+
+    def __rmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.multiply, other, self)
+
+    def __truediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.true_divide, self, other)
+
+    def __rtruediv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.true_divide, other, self)
+
+    def __floordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, self, other)
+
+    def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.floor_divide, other, self)
+
+    def __mod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, self, other)
+
+    def __rmod__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.mod, other, self)
+
+    def __pow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, self, other)
+
+    def __rpow__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.power, other, self)
+
+    def __matmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, self, other)
+
+    def __rmatmul__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.matmul, other, self)
+
+    def __and__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __rand__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_and, other, self)
+
+    def __or__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, self, other)
+
+    def __ror__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_or, other, self)
+
+    def __xor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, self, other)
+
+    def __rxor__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.bitwise_xor, other, self)
+
+    def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.equal, self, other)
+
+    def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
+        return CompositionalMetric(jnp.not_equal, self, other)
+
+    def __lt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less, self, other)
+
+    def __le__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.less_equal, self, other)
+
+    def __gt__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater, self, other)
+
+    def __ge__(self, other: Any) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.greater_equal, self, other)
+
+    def __abs__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __neg__(self) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x: -x, self, None)
+
+    def __pos__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __invert__(self) -> "CompositionalMetric":
+        return CompositionalMetric(jnp.logical_not, self, None)
+
+    def __getitem__(self, idx: Any) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x: x[idx], self, None)
+
+
+def _wrap_update(update: Callable) -> Callable:
+    @functools.wraps(update)
+    def wrapped_func(self: Metric, *args: Any, **kwargs: Any) -> None:
+        if self._is_synced:
+            raise MetricsTPUUserError(
+                "The Metric shouldn't be synced when performing ``update``. "
+                "HINT: Did you forget to call ``unsync``?"
+            )
+        self._computed = None
+        self._update_called = True
+        return update(self, *args, **kwargs)
+
+    wrapped_func._wrapped = True  # type: ignore[attr-defined]
+    return wrapped_func
+
+
+def _wrap_compute(compute: Callable) -> Callable:
+    @functools.wraps(compute)
+    def wrapped_func(self: Metric, *args: Any, **kwargs: Any) -> Any:
+        if not self._update_called:
+            rank_zero_warn(
+                f"The ``compute`` method of metric {type(self).__name__} was called before "
+                "the ``update`` method which may lead to errors, as metric states have not "
+                "yet been updated.",
+                UserWarning,
+            )
+        if self._computed is not None:
+            return self._computed
+        is_tracing = any(
+            isinstance(leaf, jax.core.Tracer) for leaf in jax.tree_util.tree_leaves(self._state)
+        )
+        should = self._to_sync and self._is_synced is False and not is_tracing
+        with self.sync_context(
+            dist_sync_fn=self.dist_sync_fn,
+            should_sync=should,
+            should_unsync=should,
+        ):
+            self._computed = compute(self, *args, **kwargs)
+        return self._computed
+
+    wrapped_func._wrapped = True  # type: ignore[attr-defined]
+    return wrapped_func
+
+
+class CompositionalMetric(Metric):
+    """Lazy arithmetic over metrics (reference ``metric.py:722-800``)."""
+
+    def __init__(
+        self,
+        operator: Callable,
+        metric_a: Union[Metric, float, int, Array],
+        metric_b: Union[Metric, float, int, Array, None],
+    ) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = metric_a if isinstance(metric_a, Metric) else (
+            jnp.asarray(metric_a) if metric_a is not None else None
+        )
+        self.metric_b = metric_b if isinstance(metric_b, Metric) else (
+            jnp.asarray(metric_b) if metric_b is not None else None
+        )
+
+    def _sync_dist(self, *args: Any, **kwargs: Any) -> None:
+        pass  # no own state; operands sync themselves
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    def compute(self) -> Any:
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b(*args, **self.metric_b._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        if val_a is None:
+            return None
+        if val_b is None:
+            if isinstance(self.metric_b, Metric):
+                return None
+            self._forward_cache = self.op(val_a)
+            return self._forward_cache
+        self._forward_cache = self.op(val_a, val_b)
+        return self._forward_cache
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else 'op'}(\n    {repr(self.metric_a)},\n    {repr(self.metric_b)}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics

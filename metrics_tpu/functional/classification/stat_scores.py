@@ -1,0 +1,181 @@
+"""tp/fp/tn/fn statistics — the backbone of the classification family.
+
+Behavioral analogue of the reference's
+``torchmetrics/functional/classification/stat_scores.py:28-397``. All shape
+dispatch is static, so every function here jits cleanly (given ``num_classes``);
+the boolean-product sums XLA fuses into a single pass over the inputs.
+"""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import AverageMethod, MDMCAverageMethod
+
+
+def _del_column(data: Array, idx: int) -> Array:
+    """Drop class column ``idx`` (static index)."""
+    return jnp.concatenate([data[:, :idx], data[:, idx + 1:]], axis=1)
+
+
+def _stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+) -> Tuple[Array, Array, Array, Array]:
+    """Count tp/fp/tn/fn over binary ``(N, C)`` or ``(N, C, X)`` inputs.
+
+    Output shapes per ``reduce`` follow the reference contract
+    (``stat_scores.py:43-56``): micro → scalar / (N,), macro → (C,) / (N,C),
+    samples → (N,) / (N,X).
+    """
+    if reduce == "micro":
+        axis: Tuple[int, ...] = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        axis = (0,) if preds.ndim == 2 else (2,)
+    else:  # samples
+        axis = (1,)
+
+    true_pred = target == preds
+    pos_pred = preds == 1
+
+    tp = jnp.sum(true_pred & pos_pred, axis=axis)
+    fp = jnp.sum(~true_pred & pos_pred, axis=axis)
+    tn = jnp.sum(true_pred & ~pos_pred, axis=axis)
+    fn = jnp.sum(~true_pred & ~pos_pred, axis=axis)
+    return (
+        tp.astype(jnp.int32),
+        fp.astype(jnp.int32),
+        tn.astype(jnp.int32),
+        fn.astype(jnp.int32),
+    )
+
+
+def _stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: Optional[str] = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+    validate: bool = True,
+) -> Tuple[Array, Array, Array, Array]:
+    """Format inputs and count statistics (reference ``stat_scores.py:76-145``)."""
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=threshold, num_classes=num_classes,
+        multiclass=multiclass, top_k=top_k, validate=validate,
+    )
+
+    if ignore_index is not None and not 0 <= ignore_index < preds.shape[1]:
+        raise ValueError(
+            f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes"
+        )
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3:
+        if not mdmc_reduce:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+            )
+        if mdmc_reduce == "global":
+            preds = jnp.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+            target = jnp.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+
+    if ignore_index is not None and reduce != "macro":
+        preds = _del_column(preds, ignore_index)
+        target = _del_column(target, ignore_index)
+
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+
+    if ignore_index is not None and reduce == "macro":
+        tp = tp.at[..., ignore_index].set(-1)
+        fp = fp.at[..., ignore_index].set(-1)
+        tn = tn.at[..., ignore_index].set(-1)
+        fn = fn.at[..., ignore_index].set(-1)
+
+    return tp, fp, tn, fn
+
+
+def _stat_scores_compute(tp: Array, fp: Array, tn: Array, fn: Array) -> Array:
+    """Stack tp/fp/tn/fn/support into one ``(..., 5)`` output."""
+    outputs = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    return jnp.where(outputs < 0, -1, outputs)
+
+
+def _reduce_stat_scores(
+    numerator: Array,
+    denominator: Array,
+    weights: Optional[Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: int = 0,
+) -> Array:
+    """Turn numerator/denominator statistics into a final averaged score.
+
+    Handles zero-division, ignored classes (denominator < 0 → masked out), and
+    the micro/macro/weighted/samples/none axes exactly as the reference's
+    ``_reduce_stat_scores`` (``stat_scores.py:183-237``) — but branch-free so
+    it fuses into one XLA kernel.
+    """
+    numerator = numerator.astype(jnp.float32)
+    denominator = denominator.astype(jnp.float32)
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+
+    weights = jnp.ones_like(denominator) if weights is None else weights.astype(jnp.float32)
+    numerator = jnp.where(zero_div_mask, float(zero_division), numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.NONE, None):
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+    scores = weights * (numerator / denominator)
+    scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
+
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+        scores = jnp.mean(scores, axis=0)
+        ignore_mask = jnp.sum(ignore_mask, axis=0).astype(bool)
+
+    if average in (AverageMethod.NONE, None):
+        return jnp.where(ignore_mask, jnp.nan, scores)
+    return jnp.sum(scores)
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Count tp/fp/tn/fn/support with flexible reduction.
+
+    Public functional entry point; contract identical to the reference's
+    ``stat_scores`` (``functional/classification/stat_scores.py:240-397``):
+    returns a ``(..., 5)`` array of ``[tp, fp, tn, fn, support]``.
+    """
+    if reduce not in ["micro", "macro", "samples"]:
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+    if mdmc_reduce not in [None, "samplewise", "global"]:
+        raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+    if reduce == "macro" and (not num_classes or num_classes < 1):
+        raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    tp, fp, tn, fn = _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_reduce, top_k=top_k,
+        threshold=threshold, num_classes=num_classes, multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _stat_scores_compute(tp, fp, tn, fn)

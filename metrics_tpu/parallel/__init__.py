@@ -1,0 +1,9 @@
+from metrics_tpu.parallel.sync import (
+    class_reduce,
+    gather_all_arrays,
+    host_sync_state,
+    jit_distributed_available,
+    reduce,
+    sync_in_jit,
+    sync_leaf_in_jit,
+)

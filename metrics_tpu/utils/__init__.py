@@ -1,0 +1,21 @@
+from metrics_tpu.utils.data import (
+    METRIC_EPS,
+    apply_to_collection,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    get_group_indexes,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from metrics_tpu.utils.enums import AverageMethod, DataType, EnumStr, MDMCAverageMethod
+from metrics_tpu.utils.exceptions import MetricsTPUUserError, TorchMetricsUserError
+from metrics_tpu.utils.prints import (
+    rank_zero_debug,
+    rank_zero_info,
+    rank_zero_only,
+    rank_zero_warn,
+)

@@ -1,0 +1,333 @@
+"""Classification / retrieval input normalization.
+
+Behavioral analogue of the reference's ``torchmetrics/utilities/checks.py:23-583``,
+re-designed for XLA:
+
+- **Case dispatch is static.** Which of binary / multi-label / multi-class /
+  multi-dim multi-class a ``(preds, target)`` pair falls into depends only on
+  shapes and dtypes, both static under jit — so :func:`_input_format_classification`
+  traces cleanly when ``num_classes`` is provided.
+- **Value-dependent validation is eager-only.** Checks like ``target.min() < 0``
+  (reference ``checks.py:32-48``) force a device sync; they run only on concrete
+  (non-traced) arrays and are skipped inside jit, mirroring the reference's
+  guidance that validation move out of the hot path.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.data import select_topk, to_onehot
+from metrics_tpu.utils.enums import DataType
+
+
+def _is_concrete(*arrays: Array) -> bool:
+    """True when no argument is a tracer (i.e. we are running eagerly)."""
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _is_floating(x: Array) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"got {preds.shape} and {target.shape}"
+        )
+
+
+def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Drop all size-1 dims except the leading batch dim (static reshape)."""
+
+    def squeeze_keep_batch(x: Array) -> Array:
+        if x.ndim <= 1:
+            return x
+        kept = [x.shape[0]] + [d for d in x.shape[1:] if d != 1]
+        return x.reshape(kept)
+
+    return squeeze_keep_batch(jnp.asarray(preds)), squeeze_keep_batch(jnp.asarray(target))
+
+
+def _classify_case(preds: Array, target: Array) -> Tuple[DataType, int]:
+    """Static shape/dtype-based case detection.
+
+    Returns ``(case, implied_classes)``; raises on inconsistent shapes. This is
+    the dispatch half of the reference's ``_check_shape_and_type_consistency``
+    (``checks.py:51-106``) with every decision jit-static.
+    """
+    preds_float = _is_floating(preds)
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                f"The `preds` and `target` should have the same shape, got "
+                f"{preds.shape} and {target.shape}."
+            )
+        if preds.ndim == 1:
+            case = DataType.BINARY if preds_float else DataType.MULTICLASS
+        else:
+            case = DataType.MULTILABEL if preds_float else DataType.MULTIDIM_MULTICLASS
+        implied_classes = 1
+        for d in preds.shape[1:]:
+            implied_classes *= d
+    elif preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, `preds` should be a float tensor."
+            )
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be "
+                "(N, C, ...) and the shape of `target` (N, ...)."
+            )
+        implied_classes = preds.shape[1]
+        case = DataType.MULTICLASS if preds.ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` "
+            "should be (N, ...) and `preds` should be (N, C, ...)."
+        )
+    return case, implied_classes
+
+
+def _validate_values(
+    preds: Array,
+    target: Array,
+    case: DataType,
+    implied_classes: int,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+) -> None:
+    """Value-dependent validation; eager-only (skipped under jit tracing)."""
+    if not _is_concrete(preds, target):
+        return
+    if _is_floating(target):
+        raise ValueError("The `target` has to be an integer tensor.")
+    if int(jnp.min(target)) < 0:
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    preds_float = _is_floating(preds)
+    if not preds_float and int(jnp.min(preds)) < 0:
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
+    if preds.shape[0] != target.shape[0]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+    if multiclass is False and int(jnp.max(target)) > 1:
+        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+    if multiclass is False and not preds_float and int(jnp.max(preds)) > 1:
+        raise ValueError(
+            "If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1."
+        )
+    if preds.ndim == target.ndim and preds_float and int(jnp.max(target)) > 1:
+        raise ValueError(
+            "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+        )
+    if preds.shape != target.shape:
+        if multiclass is False and implied_classes != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        if int(jnp.max(target)) >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+    if num_classes:
+        if case == DataType.BINARY:
+            if num_classes > 2:
+                raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+            if num_classes == 2 and not multiclass:
+                raise ValueError(
+                    "Your data is binary and `num_classes=2`, but `multiclass` is not True."
+                )
+            if num_classes == 1 and multiclass:
+                raise ValueError(
+                    "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
+                )
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            if num_classes == 1 and multiclass is not False:
+                raise ValueError(
+                    "You have set `num_classes=1`, but predictions are integers."
+                    " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+                    " to binary/multi-label, set `multiclass=False`."
+                )
+            if num_classes > 1:
+                if multiclass is False and implied_classes != num_classes:
+                    raise ValueError(
+                        "You have set `multiclass=False`, but the implied number of classes"
+                        " does not match `num_classes`."
+                    )
+                if num_classes <= int(jnp.max(target)):
+                    raise ValueError(
+                        "The highest label in `target` should be smaller than `num_classes`."
+                    )
+                if preds.shape != target.shape and num_classes != implied_classes:
+                    raise ValueError(
+                        "The size of C dimension of `preds` does not match `num_classes`."
+                    )
+        elif case == DataType.MULTILABEL:
+            if multiclass and num_classes != 2:
+                raise ValueError(
+                    "You have set `multiclass=True`, but `num_classes` is not equal to 2."
+                )
+            if not multiclass and num_classes != implied_classes:
+                raise ValueError(
+                    "The implied number of classes (from shape of inputs) does not match num_classes."
+                )
+
+
+def _check_top_k(
+    top_k: int, case: DataType, implied_classes: int, multiclass: Optional[bool], preds_float: bool
+) -> None:
+    if case == DataType.BINARY:
+        raise ValueError("You can not use `top_k` parameter with binary data.")
+    if not isinstance(top_k, int) or top_k <= 0:
+        raise ValueError("The `top_k` has to be an integer larger than 0.")
+    if not preds_float:
+        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+    if multiclass is False:
+        raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+    if case == DataType.MULTILABEL and multiclass:
+        raise ValueError(
+            "If you want to transform multi-label data to 2 class multi-dimensional"
+            " multi-class data using `multiclass=True`, you can not use `top_k`."
+        )
+    if top_k >= implied_classes:
+        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _check_classification_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    top_k: Optional[int],
+) -> DataType:
+    """Full input validation; returns the detected case.
+
+    Analogue of the reference's ``checks.py:190-281``. Static checks always run;
+    value checks only when arrays are concrete.
+    """
+    case, implied_classes = _classify_case(preds, target)
+    _validate_values(preds, target, case, implied_classes, num_classes, multiclass)
+    if top_k is not None:
+        _check_top_k(top_k, case, implied_classes, multiclass, _is_floating(preds))
+    return case
+
+
+def _input_format_classification(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    validate: bool = True,
+) -> Tuple[Array, Array, DataType]:
+    """Normalize any accepted (preds, target) pair to binary int arrays.
+
+    Output shapes are ``(N, C)`` or ``(N, C, X)``; semantics mirror the
+    reference's ``_input_format_classification`` (``checks.py:296-432``):
+
+    - binary / multi-label float preds are thresholded (or top-k'd for
+      multi-label with ``top_k``);
+    - (multi-dim) multi-class preds/targets are one-hot encoded, float preds by
+      top-k selection over the C dim;
+    - ``multiclass=True`` lifts binary/multi-label to 2-class one-hot form;
+      ``multiclass=False`` projects 2-class data down to the positive column.
+
+    jit-compatible when ``num_classes`` is given (or implied by a C dim) and
+    ``validate=False`` or inputs are traced.
+    """
+    preds, target = _input_squeeze(preds, target)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype == jnp.float16 or preds.dtype == jnp.bfloat16:
+        preds = preds.astype(jnp.float32)
+
+    if validate:
+        case = _check_classification_inputs(
+            preds, target, threshold=threshold, num_classes=num_classes,
+            multiclass=multiclass, top_k=top_k,
+        )
+    else:
+        case, _ = _classify_case(preds, target)
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32)
+        num_classes = num_classes if not multiclass else 2
+
+    if case == DataType.MULTILABEL and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or multiclass:
+        if _is_floating(preds):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            if not num_classes:
+                # data-dependent inference: eager only
+                num_classes = int(max(int(jnp.max(preds)), int(jnp.max(target)))) + 1
+            preds = to_onehot(preds, max(2, num_classes))
+        target = to_onehot(target, max(2, num_classes))
+        if multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and multiclass is not False) or multiclass:
+        target = target.reshape(target.shape[0], target.shape[1], -1)
+        preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+    else:
+        target = target.reshape(target.shape[0], -1)
+        preds = preds.reshape(preds.shape[0], -1)
+
+    # squeeze the trailing X dim the reshapes above introduce for plain MC/binary
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds, target = preds.squeeze(-1), target.squeeze(-1)
+
+    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+
+
+def _check_retrieval_target_and_prediction_types(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    if not (
+        jnp.issubdtype(target.dtype, jnp.integer)
+        or target.dtype == jnp.bool_
+        or _is_floating(target)
+    ):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
+    if not _is_floating(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not allow_non_binary_target and _is_concrete(target):
+        if int(jnp.max(target)) > 1 or int(jnp.min(target)) < 0:
+            raise ValueError("`target` must contain `binary` values")
+    target = (
+        target.astype(jnp.float32).ravel()
+        if _is_floating(target)
+        else target.astype(jnp.int32).ravel()
+    )
+    return preds.astype(jnp.float32).ravel(), target
+
+
+def _check_retrieval_functional_inputs(
+    preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array]:
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.size == 0 or preds.ndim == 0:
+        raise ValueError("`preds` and `target` must be non-empty and non-scalar tensors")
+    return _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+
+
+def _check_retrieval_inputs(
+    indexes: Array, preds: Array, target: Array, allow_non_binary_target: bool = False
+) -> Tuple[Array, Array, Array]:
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if indexes.size == 0 or indexes.ndim == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty and non-scalar tensors")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    preds, target = _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
+    return indexes.astype(jnp.int32).ravel(), preds, target

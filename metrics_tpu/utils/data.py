@@ -1,0 +1,127 @@
+"""Array utilities shared by every metric.
+
+TPU-native analogue of the reference's ``torchmetrics/utilities/data.py:21-227``.
+All ops are pure jnp and jit-safe unless noted; ``get_group_indexes`` is the one
+host-side helper (ragged output) — :mod:`metrics_tpu.ops.segment` holds the
+jittable segment-op alternative used by retrieval metrics.
+"""
+from typing import Any, Callable, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+METRIC_EPS = 1e-6
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate a (possibly list-valued) state along dim 0."""
+    x = list(x) if isinstance(x, (list, tuple)) else [x]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    x = [y[None] if y.ndim == 0 else y for y in map(jnp.asarray, x)]
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    return [item for sublist in x for item in sublist]
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert integer labels ``[N, d1, ...]`` to one-hot ``[N, C, d1, ...]``.
+
+    Mirrors the reference's ``utilities/data.py:44-75`` but as a broadcast
+    compare (XLA fuses it; no scatter needed).
+    """
+    label_tensor = jnp.asarray(label_tensor)
+    if num_classes is None:
+        num_classes = int(jnp.max(label_tensor)) + 1  # data-dependent: eager only
+    classes = jnp.arange(num_classes).reshape((num_classes,) + (1,) * label_tensor.ndim)
+    onehot = (label_tensor[None] == classes).astype(jnp.int32)
+    return jnp.moveaxis(onehot, 0, 1)  # [C, N, ...] -> [N, C, ...]
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binarize a score tensor: 1 where a value is among the top-k along ``dim``.
+
+    Analogue of ``utilities/data.py:78-101``; uses ``jax.lax.top_k`` (MXU-free,
+    bitonic on TPU) + masked scatter via ``put_along_axis``.
+    """
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    mask = jnp.zeros(moved.shape, dtype=jnp.int32)
+    mask = jnp.put_along_axis(mask, idx, 1, axis=-1, inplace=False)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(tensor: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities/one-hot ``[N, C, ...]`` -> integer labels ``[N, ...]``."""
+    return jnp.argmax(tensor, axis=argmax_dim)
+
+
+def get_group_indexes(indexes: Array) -> List[Array]:
+    """Group row positions by query id (host-side, ragged output).
+
+    Analogue of ``utilities/data.py:203-227``. Eager-only: retrieval metrics'
+    jitted path uses sorted segment ops instead (``metrics_tpu/ops/segment.py``).
+    """
+    indexes = np.asarray(indexes)
+    res: dict = {}
+    for i, idx in enumerate(indexes.tolist()):
+        res.setdefault(idx, []).append(i)
+    return [jnp.asarray(x, dtype=jnp.int32) for x in res.values()]
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Union[type, tuple],
+    function: Callable,
+    *args: Any,
+    wrong_dtype: Optional[Union[type, tuple]] = None,
+    **kwargs: Any,
+) -> Any:
+    """Recursively apply ``function`` to all ``dtype`` leaves of a collection.
+
+    Analogue of ``utilities/data.py:153-200``.
+    """
+    if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
+        return function(data, *args, **kwargs)
+    if isinstance(data, Mapping):
+        return type(data)(
+            {k: apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for k, v in data.items()}
+        )
+    if isinstance(data, tuple) and hasattr(data, "_fields"):  # namedtuple
+        return type(data)(*(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data))
+    if isinstance(data, (list, tuple)):
+        return type(data)(apply_to_collection(d, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for d in data)
+    return data
+
+
+def _bincount(x: Array, minlength: int) -> Array:
+    """Static-shape bincount: counts of each value in ``[0, minlength)``.
+
+    jit-safe replacement for ``torch.bincount`` used by confusion-matrix style
+    scatter accumulation; lowers to one-hot matmul-free segment sum on TPU.
+    """
+    return jnp.zeros(minlength, dtype=jnp.int32).at[x.astype(jnp.int32)].add(1)
+
+
+def allclose(a: Array, b: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    return bool(jnp.allclose(a, b, rtol=rtol, atol=atol))

@@ -1,0 +1,12 @@
+"""Error types for API misuse.
+
+TPU-native analogue of the reference's ``torchmetrics/utilities/exceptions.py:16``.
+"""
+
+
+class MetricsTPUUserError(Exception):
+    """Raised when the metrics-TPU API is used incorrectly (e.g. double-sync)."""
+
+
+# Alias kept for users migrating from the reference library.
+TorchMetricsUserError = MetricsTPUUserError

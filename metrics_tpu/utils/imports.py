@@ -1,0 +1,38 @@
+"""Optional-dependency detection.
+
+TPU-native analogue of the reference's ``torchmetrics/utilities/imports.py:24-84``.
+Only packages actually consulted by this framework are probed; everything heavy
+(transformers for BERTScore tokenization, nltk for ROUGE stemming) is optional.
+"""
+import importlib
+import operator
+from typing import Callable
+
+from packaging.version import Version
+
+
+def _module_available(module_path: str) -> bool:
+    """True if ``module_path`` is importable without importing it eagerly."""
+    try:
+        return importlib.util.find_spec(module_path) is not None
+    except (ModuleNotFoundError, AttributeError, ValueError):
+        return False
+
+
+def _compare_version(package: str, op: Callable, version: str) -> bool:
+    try:
+        pkg = importlib.import_module(package)
+        pkg_version = Version(getattr(pkg, "__version__", "0"))
+    except (ImportError, TypeError):
+        return False
+    return op(pkg_version, Version(version))
+
+
+_JAX_AVAILABLE = _module_available("jax")
+_FLAX_AVAILABLE = _module_available("flax")
+_TRANSFORMERS_AVAILABLE = _module_available("transformers")
+_NLTK_AVAILABLE = _module_available("nltk")
+_ROUGE_SCORE_AVAILABLE = _module_available("rouge_score")
+_SCIPY_AVAILABLE = _module_available("scipy")
+_TORCH_AVAILABLE = _module_available("torch")
+_JAX_GREATER_EQUAL_0_4 = _compare_version("jax", operator.ge, "0.4.0")

@@ -1,0 +1,126 @@
+"""Accuracy parity vs sklearn, mirroring the reference's
+`tests/classification/test_accuracy.py` strategy."""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu import Accuracy
+from metrics_tpu.functional import accuracy
+from metrics_tpu.utils.checks import _input_format_classification
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multidim_multiclass,
+    _input_multidim_multiclass_prob,
+    _input_multilabel,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_accuracy(preds, target, subset_accuracy=False):
+    # normalize through the same input formatting, then sklearn (mirrors the
+    # reference test's approach of comparing post-format data)
+    sk_preds, sk_target, mode = _input_format_classification(preds, target, threshold=THRESHOLD)
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    if mode == "multi-dim multi-class" and not subset_accuracy:
+        sk_preds, sk_target = np.moveaxis(sk_preds, 1, -1).reshape(-1, sk_preds.shape[1]), np.moveaxis(
+            sk_target, 1, -1
+        ).reshape(-1, sk_target.shape[1])
+    elif mode == "multi-label" and not subset_accuracy:
+        sk_preds, sk_target = sk_preds.reshape(-1), sk_target.reshape(-1)
+    elif mode == "multi-dim multi-class" and subset_accuracy:
+        return np.mean((np.sum(sk_preds * sk_target, axis=(1, 2)) == sk_preds.shape[2]))
+    return sk_accuracy(y_true=sk_target, y_pred=sk_preds)
+
+
+@pytest.mark.parametrize(
+    "preds, target, subset_accuracy",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, False),
+        (_input_binary.preds, _input_binary.target, False),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, True),
+        (_input_multilabel_prob.preds, _input_multilabel_prob.target, False),
+        (_input_multilabel.preds, _input_multilabel.target, True),
+        (_input_multilabel.preds, _input_multilabel.target, False),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, False),
+        (_input_multiclass.preds, _input_multiclass.target, False),
+        (_input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target, False),
+        (_input_multidim_multiclass_prob.preds, _input_multidim_multiclass_prob.target, True),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, False),
+        (_input_multidim_multiclass.preds, _input_multidim_multiclass.target, True),
+    ],
+)
+class TestAccuracies(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_accuracy_class(self, ddp, preds, target, subset_accuracy):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+        )
+
+    def test_accuracy_fn(self, preds, target, subset_accuracy):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+        )
+
+    def test_accuracy_sharded(self, preds, target, subset_accuracy):
+        self.run_sharded_metric_test(
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=lambda p, t: _sk_accuracy(p, t, subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+        )
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, average",
+    [
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, NUM_CLASSES, "macro"),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, NUM_CLASSES, "weighted"),
+        (_input_multiclass.preds, _input_multiclass.target, NUM_CLASSES, "macro"),
+    ],
+)
+def test_accuracy_averages(preds, target, num_classes, average):
+    """macro/weighted accuracy == sklearn recall with that average."""
+    from sklearn.metrics import recall_score
+
+    import jax.numpy as jnp
+
+    total_preds = np.concatenate(list(preds), axis=0)
+    total_target = np.concatenate(list(target), axis=0)
+    sk_preds = total_preds.argmax(-1) if total_preds.ndim > 1 else total_preds
+    expected = recall_score(total_target, sk_preds, average=average)
+    result = accuracy(
+        jnp.asarray(total_preds), jnp.asarray(total_target), average=average, num_classes=num_classes
+    )
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+
+def test_accuracy_topk():
+    import jax.numpy as jnp
+
+    preds = jnp.asarray([[0.1, 0.9, 0.0], [0.3, 0.1, 0.6], [0.2, 0.5, 0.3]])
+    target = jnp.asarray([0, 1, 2])
+    np.testing.assert_allclose(np.asarray(accuracy(preds, target, top_k=2)), 2 / 3, atol=1e-6)
+
+
+def test_accuracy_invalid_input():
+    import jax.numpy as jnp
+
+    with pytest.raises(ValueError):
+        accuracy(jnp.asarray([1, 2]), jnp.asarray([0, 1]), average="not-an-average")
+    with pytest.raises(ValueError):
+        accuracy(jnp.asarray([1.0, 0.2]), jnp.asarray([0.0, 1.0]))  # float target

@@ -1,0 +1,24 @@
+"""Global test configuration.
+
+Forces an 8-device virtual CPU platform BEFORE any backend initializes, so
+every test can exercise multi-device meshes (`jax.sharding.Mesh` + shard_map
+collectives) without TPU hardware — the analogue of the reference's 2-process
+gloo simulation (`tests/helpers/testers.py:33-57`).
+
+jax may already be *imported* (preloaded interpreter-wide), so env vars alone
+are too late for `jax_platforms`; `jax.config.update` works until the first
+backend is actually created. XLA_FLAGS is read at CPU-client creation, which
+also hasn't happened yet at conftest load time.
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+assert len(jax.devices()) == 8, "virtual CPU mesh failed to initialize"
