@@ -15,12 +15,35 @@ __version__ = "0.1.0"
 
 from metrics_tpu.core.collections import MetricCollection
 from metrics_tpu.core.metric import CompositionalMetric, Metric
-from metrics_tpu.classification import Accuracy, StatScores
+from metrics_tpu.classification import (
+    F1,
+    Accuracy,
+    CohenKappa,
+    ConfusionMatrix,
+    FBeta,
+    HammingDistance,
+    IoU,
+    MatthewsCorrcoef,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
 
 __all__ = [
     "Accuracy",
+    "CohenKappa",
     "CompositionalMetric",
+    "ConfusionMatrix",
+    "F1",
+    "FBeta",
+    "HammingDistance",
+    "IoU",
+    "MatthewsCorrcoef",
     "Metric",
     "MetricCollection",
+    "Precision",
+    "Recall",
+    "Specificity",
     "StatScores",
 ]

@@ -1,0 +1,62 @@
+"""ConfusionMatrix module metric.
+
+Behavioral analogue of the reference's
+``torchmetrics/classification/confusion_matrix.py`` (145 LoC): one [C, C]
+(or [C, 2, 2] multilabel) sum state, psum across the mesh.
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _confusion_matrix_compute,
+    _confusion_matrix_update,
+)
+
+
+class ConfusionMatrix(Metric):
+    """Confusion matrix with optional 'true'/'pred'/'all' normalization."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        normalize: Optional[str] = None,
+        threshold: float = 0.5,
+        multilabel: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self.threshold = threshold
+        self.multilabel = multilabel
+
+        allowed_normalize = ("true", "pred", "all", "none", None)
+        if normalize not in allowed_normalize:
+            raise ValueError(f"Argument average needs to one of the following: {allowed_normalize}")
+
+        default = (
+            jnp.zeros((num_classes, 2, 2), dtype=jnp.int32)
+            if multilabel
+            else jnp.zeros((num_classes, num_classes), dtype=jnp.int32)
+        )
+        self.add_state("confmat", default=default, dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        confmat = _confusion_matrix_update(
+            preds, target, self.num_classes, self.threshold, self.multilabel
+        )
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> Array:
+        return _confusion_matrix_compute(self.confmat, self.normalize)

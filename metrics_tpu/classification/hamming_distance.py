@@ -1,0 +1,45 @@
+"""HammingDistance module metric.
+
+Behavioral analogue of the reference's
+``torchmetrics/classification/hamming_distance.py`` (113 LoC).
+"""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.hamming_distance import (
+    _hamming_distance_compute,
+    _hamming_distance_update,
+)
+
+
+class HammingDistance(Metric):
+    r"""Average Hamming loss: fraction of wrongly predicted labels."""
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("correct", default=jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.threshold = threshold
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        correct, total = _hamming_distance_update(preds, target, self.threshold)
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hamming_distance_compute(self.correct, self.total)

@@ -1,0 +1,45 @@
+"""IoU (Jaccard index) module metric.
+
+Behavioral analogue of the reference's ``torchmetrics/classification/iou.py``
+(110 LoC): subclasses ConfusionMatrix.
+"""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.confusion_matrix import ConfusionMatrix
+from metrics_tpu.functional.classification.iou import _iou_from_confmat
+
+
+class IoU(ConfusionMatrix):
+    r"""Jaccard index from an accumulated confusion matrix."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        ignore_index: Optional[int] = None,
+        absent_score: float = 0.0,
+        threshold: float = 0.5,
+        reduction: str = "elementwise_mean",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes,
+            normalize=None,
+            threshold=threshold,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.reduction = reduction
+        self.ignore_index = ignore_index
+        self.absent_score = absent_score
+
+    def compute(self) -> Array:
+        return _iou_from_confmat(
+            self.confmat, self.num_classes, self.ignore_index, self.absent_score, self.reduction
+        )

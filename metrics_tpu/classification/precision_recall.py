@@ -1,0 +1,67 @@
+"""Precision / Recall module metrics.
+
+Behavioral analogue of the reference's
+``torchmetrics/classification/precision_recall.py`` (326 LoC): both subclass
+:class:`StatScores` and reduce at compute time.
+"""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.precision_recall import (
+    _precision_compute,
+    _recall_compute,
+)
+
+
+class _PrecisionRecallBase(StatScores):
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: str = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        if average in ["macro", "weighted", "none", None] and (not num_classes or num_classes < 1):
+            raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.average = average
+
+
+class Precision(_PrecisionRecallBase):
+    r"""Precision :math:`\frac{TP}{TP + FP}` (reference ``precision_recall.py:28``)."""
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _precision_compute(tp, fp, fn, self.average, self.mdmc_reduce)
+
+
+class Recall(_PrecisionRecallBase):
+    r"""Recall :math:`\frac{TP}{TP + FN}` (reference ``precision_recall.py:180``)."""
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _recall_compute(tp, fp, fn, self.average, self.mdmc_reduce)

@@ -1,0 +1,53 @@
+"""Specificity module metric.
+
+Behavioral analogue of the reference's
+``torchmetrics/classification/specificity.py`` (174 LoC).
+"""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.functional.classification.specificity import _specificity_compute
+
+
+class Specificity(StatScores):
+    r"""Specificity :math:`\frac{TN}{TN + FP}` (reference ``specificity.py:28``)."""
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: str = "micro",
+        mdmc_average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        if average in ["macro", "weighted", "none", None] and (not num_classes or num_classes < 1):
+            raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+        super().__init__(
+            reduce="macro" if average in ["weighted", "none", None] else average,
+            mdmc_reduce=mdmc_average,
+            threshold=threshold,
+            top_k=top_k,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            ignore_index=ignore_index,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.average = average
+
+    def compute(self) -> Array:
+        tp, fp, tn, fn = self._get_final_stats()
+        return _specificity_compute(tp, fp, tn, fn, self.average, self.mdmc_reduce)

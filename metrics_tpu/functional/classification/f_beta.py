@@ -1,0 +1,128 @@
+"""F-beta / F1 — functional layer.
+
+Behavioral analogue of the reference's
+``torchmetrics/functional/classification/f_beta.py:24-140``, with the dynamic
+boolean-index filtering replaced by -1 sentinel masking (jit-safe).
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.stat_scores import (
+    _reduce_stat_scores,
+    _stat_scores_update,
+)
+from metrics_tpu.utils.enums import AverageMethod as AvgMethod
+from metrics_tpu.utils.enums import MDMCAverageMethod
+
+
+def _safe_divide(num: Array, denom: Array) -> Array:
+    """num / denom with 0-denominators mapped to 1 (result 0 where num is 0)."""
+    return num / jnp.where(denom == 0.0, 1.0, denom)
+
+
+def _fbeta_compute(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: str,
+    mdmc_average: Optional[str],
+) -> Array:
+    """F-beta from stat scores (reference ``f_beta.py:30-108``)."""
+    if average == AvgMethod.MICRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        # negative (ignored) entries are excluded from the global sums
+        valid = tp >= 0
+        tp_s = jnp.sum(jnp.where(valid, tp, 0)).astype(jnp.float32)
+        fp_s = jnp.sum(jnp.where(valid, fp, 0)).astype(jnp.float32)
+        fn_s = jnp.sum(jnp.where(valid, fn, 0)).astype(jnp.float32)
+        precision = _safe_divide(tp_s, tp_s + fp_s)
+        recall = _safe_divide(tp_s, tp_s + fn_s)
+    else:
+        precision = _safe_divide(tp.astype(jnp.float32), (tp + fp).astype(jnp.float32))
+        recall = _safe_divide(tp.astype(jnp.float32), (tp + fn).astype(jnp.float32))
+
+    num = (1 + beta ** 2) * precision * recall
+    denom = beta ** 2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+
+    # classes absent from preds AND target are meaningless (nan for 'none',
+    # excluded for 'macro'); merge with the user's ignore_index
+    if average not in (AvgMethod.MICRO, AvgMethod.SAMPLES):
+        mask = jnp.zeros_like(jnp.asarray(tp), dtype=bool)
+        if average == AvgMethod.NONE and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+            mask = mask | ((tp | fn | fp) == 0)
+        if ignore_index is not None:
+            if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+                onehot = jnp.arange(tp.shape[-1]) == ignore_index
+                mask = mask | onehot
+            else:
+                onehot = jnp.arange(tp.shape[0]) == ignore_index
+                mask = mask | onehot.reshape((-1,) + (1,) * (tp.ndim - 1))
+        num = jnp.where(mask, -1.0, num)
+        denom = jnp.where(mask, -1.0, denom)
+
+    if average == AvgMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        cond = ((tp + fp + fn) == 0) | ((tp + fp + fn) == -3)
+        num = jnp.where(cond, -1.0, num)
+        denom = jnp.where(cond, -1.0, denom)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != AvgMethod.WEIGHTED else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    r"""F-beta :math:`(1+\beta^2)\frac{P \cdot R}{\beta^2 P + R}`
+    (reference ``f_beta.py:111-215``)."""
+    allowed_average = list(AvgMethod)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    if average in ["macro", "weighted", "none", None] and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds, target, reduce=reduce, mdmc_reduce=mdmc_average, threshold=threshold,
+        num_classes=num_classes, top_k=top_k, multiclass=multiclass, ignore_index=ignore_index,
+    )
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1(
+    preds: Array,
+    target: Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+) -> Array:
+    """F1 = F-beta with beta=1 (reference ``f_beta.py:218-320``)."""
+    return fbeta(
+        preds, target, 1.0, average, mdmc_average, ignore_index, num_classes,
+        threshold, top_k, multiclass,
+    )

@@ -1,0 +1,30 @@
+"""Hamming distance — functional layer.
+
+Behavioral analogue of the reference's
+``torchmetrics/functional/classification/hamming_distance.py:22-97``.
+"""
+from typing import Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _input_format_classification
+
+
+def _hamming_distance_update(
+    preds: Array, target: Array, threshold: float = 0.5
+) -> Tuple[Array, int]:
+    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+    correct = jnp.sum(preds == target)
+    total = preds.size
+    return correct, total
+
+
+def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array:
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
+    r"""Average Hamming loss: fraction of labels predicted incorrectly."""
+    correct, total = _hamming_distance_update(preds, target, threshold)
+    return _hamming_distance_compute(correct, total)

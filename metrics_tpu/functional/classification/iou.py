@@ -1,0 +1,50 @@
+"""Intersection over union (Jaccard) — functional layer.
+
+Behavioral analogue of the reference's
+``torchmetrics/functional/classification/iou.py:24-133``.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.parallel.sync import reduce
+from metrics_tpu.utils.data import get_num_classes
+
+
+def _iou_from_confmat(
+    confmat: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Per-class IoU = diag / (rowsum + colsum - diag), with absent-class and
+    ignore-index policies (reference ``iou.py:24-66``)."""
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        confmat = confmat.at[ignore_index].set(jnp.zeros((), dtype=confmat.dtype))
+
+    intersection = jnp.diag(confmat)
+    union = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - intersection
+    scores = intersection.astype(jnp.float32) / union.astype(jnp.float32)
+    scores = jnp.where(union == 0, absent_score, scores)
+
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1:]], axis=0)
+    return reduce(scores, reduction=reduction)
+
+
+def iou(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    r"""Jaccard index :math:`J(A,B) = \frac{|A\cap B|}{|A\cup B|}`."""
+    num_classes = get_num_classes(preds=preds, target=target, num_classes=num_classes)
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
+    return _iou_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

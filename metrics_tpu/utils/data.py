@@ -76,6 +76,27 @@ def to_categorical(tensor: Array, argmax_dim: int = 1) -> Array:
     return jnp.argmax(tensor, axis=argmax_dim)
 
 
+def get_num_classes(preds: Array, target: Array, num_classes: Optional[int] = None) -> int:
+    """Infer the number of classes from data (eager-only: reads values).
+
+    Analogue of reference ``utilities/data.py:122-151``.
+    """
+    from metrics_tpu.utils.prints import rank_zero_warn
+
+    num_target_classes = int(jnp.max(target)) + 1
+    num_pred_classes = int(jnp.max(preds)) + 1
+    num_all_classes = max(num_target_classes, num_pred_classes)
+    if num_classes is None:
+        num_classes = num_all_classes
+    elif num_classes != num_all_classes:
+        rank_zero_warn(
+            f"You have set {num_classes} number of classes which is different from predicted "
+            f"({num_pred_classes}) and target ({num_target_classes}) number of classes",
+            RuntimeWarning,
+        )
+    return num_classes
+
+
 def get_group_indexes(indexes: Array) -> List[Array]:
     """Group row positions by query id (host-side, ragged output).
 

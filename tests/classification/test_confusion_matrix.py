@@ -1,0 +1,150 @@
+"""ConfusionMatrix / CohenKappa / Matthews / IoU / Hamming parity vs sklearn."""
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    cohen_kappa_score,
+    confusion_matrix as sk_confusion_matrix,
+    hamming_loss,
+    jaccard_score,
+    matthews_corrcoef as sk_matthews_corrcoef,
+)
+
+from metrics_tpu import (
+    CohenKappa,
+    ConfusionMatrix,
+    HammingDistance,
+    IoU,
+    MatthewsCorrcoef,
+)
+from metrics_tpu.functional import (
+    cohen_kappa,
+    confusion_matrix,
+    hamming_distance,
+    iou,
+    matthews_corrcoef,
+)
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _hard(preds):
+    if preds.ndim > 1 and preds.dtype.kind == "f":
+        return preds.argmax(-1)
+    if preds.dtype.kind == "f":
+        return (preds >= THRESHOLD).astype(int)
+    return preds
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, 2),
+        (_input_multiclass.preds, _input_multiclass.target, NUM_CLASSES),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target, NUM_CLASSES),
+    ],
+)
+class TestConfusionMatrix(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("normalize", [None, "true", "pred", "all"])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_confmat_class(self, ddp, preds, target, num_classes, normalize):
+        def sk_cm(p, t):
+            cm = sk_confusion_matrix(t.ravel(), _hard(p).ravel(), labels=list(range(num_classes)))
+            if normalize == "true":
+                cm = cm / cm.sum(axis=1, keepdims=True)
+            elif normalize == "pred":
+                cm = cm / cm.sum(axis=0, keepdims=True)
+            elif normalize == "all":
+                cm = cm / cm.sum()
+            return np.nan_to_num(cm)
+
+        self.run_class_metric_test(
+            ddp=ddp, preds=preds, target=target, metric_class=ConfusionMatrix,
+            sk_metric=sk_cm,
+            metric_args={"num_classes": num_classes, "normalize": normalize, "threshold": THRESHOLD},
+        )
+
+    def test_confmat_sharded(self, preds, target, num_classes):
+        self.run_sharded_metric_test(
+            preds=preds, target=target, metric_class=ConfusionMatrix,
+            sk_metric=lambda p, t: sk_confusion_matrix(
+                t.ravel(), _hard(p).ravel(), labels=list(range(num_classes))
+            ),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+        )
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_cohen_kappa_class(self, preds, target, num_classes, weights):
+        self.run_class_metric_test(
+            ddp=False, preds=preds, target=target, metric_class=CohenKappa,
+            sk_metric=lambda p, t: cohen_kappa_score(t.ravel(), _hard(p).ravel(), weights=weights),
+            metric_args={"num_classes": num_classes, "weights": weights, "threshold": THRESHOLD},
+        )
+
+    def test_matthews_class(self, preds, target, num_classes):
+        self.run_class_metric_test(
+            ddp=False, preds=preds, target=target, metric_class=MatthewsCorrcoef,
+            sk_metric=lambda p, t: sk_matthews_corrcoef(t.ravel(), _hard(p).ravel()),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+        )
+
+    def test_iou_class(self, preds, target, num_classes):
+        self.run_class_metric_test(
+            ddp=False, preds=preds, target=target, metric_class=IoU,
+            sk_metric=lambda p, t: jaccard_score(t.ravel(), _hard(p).ravel(), average="macro"),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+        )
+
+
+def test_hamming_distance():
+    import jax.numpy as jnp
+
+    preds = _input_multilabel_prob.preds[0]
+    target = _input_multilabel_prob.target[0]
+    expected = hamming_loss(target.ravel(), (preds >= THRESHOLD).astype(int).ravel())
+    result = hamming_distance(jnp.asarray(preds), jnp.asarray(target), threshold=THRESHOLD)
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+
+def test_hamming_distance_class_ddp():
+    tester = MetricTester()
+    tester.atol = 1e-6
+    tester.run_class_metric_test(
+        ddp=True,
+        preds=_input_multilabel_prob.preds,
+        target=_input_multilabel_prob.target,
+        metric_class=HammingDistance,
+        sk_metric=lambda p, t: hamming_loss(t.ravel(), (p >= THRESHOLD).astype(int).ravel()),
+        metric_args={"threshold": THRESHOLD},
+    )
+
+
+def test_iou_absent_score_and_ignore_index():
+    import jax.numpy as jnp
+
+    preds = jnp.asarray([0, 1, 1, 1])
+    target = jnp.asarray([0, 1, 1, 1])
+    # class 2 absent -> absent_score
+    res = iou(preds, target, num_classes=3, absent_score=0.77, reduction="none")
+    np.testing.assert_allclose(np.asarray(res), [1.0, 1.0, 0.77], atol=1e-6)
+    # ignore_index drops class 0
+    res2 = iou(preds, target, num_classes=3, ignore_index=0, absent_score=0.5, reduction="none")
+    assert np.asarray(res2).shape[0] == 2
+
+
+def test_dice_score():
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional import dice_score
+
+    pred = jnp.asarray(
+        [[0.85, 0.05, 0.05, 0.05], [0.05, 0.85, 0.05, 0.05], [0.05, 0.05, 0.85, 0.05], [0.05, 0.05, 0.05, 0.85]]
+    )
+    target = jnp.asarray([0, 1, 3, 2])
+    np.testing.assert_allclose(np.asarray(dice_score(pred, target)), 0.3333, atol=1e-4)

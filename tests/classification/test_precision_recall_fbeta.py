@@ -1,0 +1,150 @@
+"""Precision/Recall/FBeta/F1/Specificity parity vs sklearn."""
+import numpy as np
+import pytest
+from sklearn.metrics import fbeta_score, precision_score, recall_score
+
+from metrics_tpu import F1, FBeta, Precision, Recall, Specificity
+from metrics_tpu.functional import f1, fbeta, precision, recall, specificity
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_prec(preds, target, average="micro"):
+    return precision_score(
+        target, (preds >= THRESHOLD).astype(int) if preds.dtype.kind == "f" and preds.ndim == 1 else preds.argmax(-1) if preds.ndim > 1 else preds,
+        average=average, zero_division=0,
+    )
+
+
+def _sk_wrap(sk_fn, preds, target, average, **kw):
+    if preds.ndim > target.ndim:  # probs over classes
+        y_pred = preds.argmax(-2 if preds.ndim == target.ndim + 2 else -1)
+        binary = False
+    elif preds.dtype.kind == "f":
+        y_pred = (preds >= THRESHOLD).astype(int)
+        binary = True
+    else:
+        y_pred = preds
+        binary = False
+    # the reference's "micro" on binary inputs scores the positive class only,
+    # which is sklearn's average='binary'
+    if binary and average == "micro":
+        average = "binary"
+    return sk_fn(target.ravel(), y_pred.ravel(), average=average, zero_division=0, **kw)
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target),
+        (_input_multiclass.preds, _input_multiclass.target),
+        (_input_multiclass_prob.preds, _input_multiclass_prob.target),
+    ],
+)
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+class TestPrecisionRecall(MetricTester):
+    atol = 1e-6
+
+    @staticmethod
+    def _args(preds, average):
+        binary = preds.ndim == 2  # fixtures: [NB, B] = binary, [NB, B, C] = multiclass
+        if binary and average != "micro":
+            pytest.skip("macro/weighted on raw binary inputs is invalid reference API")
+        args = {"average": average, "threshold": THRESHOLD}
+        if not binary:
+            args["num_classes"] = NUM_CLASSES
+        return args
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_precision_class(self, ddp, preds, target, average):
+        self.run_class_metric_test(
+            ddp=ddp, preds=preds, target=target, metric_class=Precision,
+            sk_metric=lambda p, t: _sk_wrap(precision_score, p, t, average),
+            metric_args=self._args(preds, average),
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_recall_class(self, ddp, preds, target, average):
+        self.run_class_metric_test(
+            ddp=ddp, preds=preds, target=target, metric_class=Recall,
+            sk_metric=lambda p, t: _sk_wrap(recall_score, p, t, average),
+            metric_args=self._args(preds, average),
+        )
+
+    def test_precision_fn(self, preds, target, average):
+        self.run_functional_metric_test(
+            preds, target, metric_functional=precision,
+            sk_metric=lambda p, t: _sk_wrap(precision_score, p, t, average),
+            metric_args=self._args(preds, average),
+        )
+
+    def test_recall_fn(self, preds, target, average):
+        self.run_functional_metric_test(
+            preds, target, metric_functional=recall,
+            sk_metric=lambda p, t: _sk_wrap(recall_score, p, t, average),
+            metric_args=self._args(preds, average),
+        )
+
+    @pytest.mark.parametrize("beta", [0.5, 1.0, 2.0])
+    def test_fbeta_class(self, preds, target, average, beta):
+        self.run_class_metric_test(
+            ddp=False, preds=preds, target=target, metric_class=FBeta,
+            sk_metric=lambda p, t: _sk_wrap(fbeta_score, p, t, average, beta=beta),
+            metric_args={**self._args(preds, average), "beta": beta},
+        )
+
+    def test_f1_sharded(self, preds, target, average):
+        self.run_sharded_metric_test(
+            preds=preds, target=target, metric_class=F1,
+            sk_metric=lambda p, t: _sk_wrap(fbeta_score, p, t, average, beta=1.0),
+            metric_args=self._args(preds, average),
+        )
+
+
+def test_specificity_binary():
+    """Specificity == recall of the negative class for binary data."""
+    import jax.numpy as jnp
+
+    preds = _input_binary_prob.preds[0]
+    target = _input_binary_prob.target[0]
+    hard = (preds >= THRESHOLD).astype(int)
+    tn = int(np.sum((hard == 0) & (target == 0)))
+    fp = int(np.sum((hard == 1) & (target == 0)))
+    expected = tn / (tn + fp)
+    result = specificity(jnp.asarray(preds), jnp.asarray(target), threshold=THRESHOLD)
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+
+def test_specificity_macro_multiclass():
+    import jax.numpy as jnp
+
+    preds = _input_multiclass_prob.preds[0]
+    target = _input_multiclass_prob.target[0]
+    hard = preds.argmax(-1)
+    per_class = []
+    for c in range(NUM_CLASSES):
+        tn = np.sum((hard != c) & (target != c))
+        fp = np.sum((hard == c) & (target != c))
+        per_class.append(tn / (tn + fp))
+    expected = np.mean(per_class)
+    result = specificity(
+        jnp.asarray(preds), jnp.asarray(target), average="macro", num_classes=NUM_CLASSES
+    )
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+
+def test_multilabel_micro_f1():
+    import jax.numpy as jnp
+    from sklearn.metrics import f1_score
+
+    preds = _input_multilabel_prob.preds[0]
+    target = _input_multilabel_prob.target[0]
+    expected = f1_score(target.ravel(), (preds >= THRESHOLD).astype(int).ravel(), zero_division=0)
+    # multilabel micro in the reference counts each label separately
+    result = f1(jnp.asarray(preds), jnp.asarray(target), threshold=THRESHOLD)
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
