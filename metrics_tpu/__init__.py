@@ -16,6 +16,17 @@ __version__ = "0.1.0"
 from metrics_tpu.core.collections import MetricCollection
 from metrics_tpu.core.metric import CompositionalMetric, Metric
 from metrics_tpu.classification import (
+    AUC,
+    AUROC,
+    AveragePrecision,
+    CalibrationError,
+    Hinge,
+    KLDivergence,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    PrecisionRecallCurve,
+    ROC,
     F1,
     Accuracy,
     CohenKappa,
@@ -31,7 +42,18 @@ from metrics_tpu.classification import (
 )
 
 __all__ = [
+    "AUC",
+    "AUROC",
     "Accuracy",
+    "AveragePrecision",
+    "CalibrationError",
+    "Hinge",
+    "KLDivergence",
+    "BinnedAveragePrecision",
+    "BinnedPrecisionRecallCurve",
+    "BinnedRecallAtFixedPrecision",
+    "PrecisionRecallCurve",
+    "ROC",
     "CohenKappa",
     "CompositionalMetric",
     "ConfusionMatrix",

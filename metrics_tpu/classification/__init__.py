@@ -8,3 +8,16 @@ from metrics_tpu.classification.matthews_corrcoef import MatthewsCorrcoef
 from metrics_tpu.classification.precision_recall import Precision, Recall
 from metrics_tpu.classification.specificity import Specificity
 from metrics_tpu.classification.stat_scores import StatScores
+from metrics_tpu.classification.auc import AUC
+from metrics_tpu.classification.auroc import AUROC
+from metrics_tpu.classification.average_precision import AveragePrecision
+from metrics_tpu.classification.binned_precision_recall import (
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+)
+from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve
+from metrics_tpu.classification.roc import ROC
+from metrics_tpu.classification.calibration_error import CalibrationError
+from metrics_tpu.classification.hinge import Hinge
+from metrics_tpu.classification.kl_divergence import KLDivergence

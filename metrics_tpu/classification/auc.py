@@ -1,0 +1,44 @@
+"""AUC module metric (generic x/y curve area).
+
+Behavioral analogue of the reference's ``torchmetrics/classification/auc.py``
+(96 LoC).
+"""
+from typing import Any, Callable, Optional
+
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class AUC(Metric):
+    """Area under any accumulated (x, y) curve via the trapezoidal rule."""
+
+    def __init__(
+        self,
+        reorder: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.reorder = reorder
+        self.add_state("x", default=[], dist_reduce_fx="cat")
+        self.add_state("y", default=[], dist_reduce_fx="cat")
+
+    def update(self, x: Array, y: Array) -> None:  # type: ignore[override]
+        x, y = _auc_update(x, y)
+        self.x.append(x)
+        self.y.append(y)
+
+    def compute(self) -> Array:
+        x = dim_zero_cat(self.x)
+        y = dim_zero_cat(self.y)
+        return _auc_compute(x, y, reorder=self.reorder)

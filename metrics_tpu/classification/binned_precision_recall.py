@@ -1,0 +1,150 @@
+"""Binned (constant-memory) PR-curve family — the TPU-preferred design.
+
+Behavioral analogue of the reference's
+``torchmetrics/classification/binned_precision_recall.py:45-324``, with one
+TPU-first change: the reference iterates one threshold at a time to conserve
+memory (``binned_precision_recall.py:163-168``); here the [N, C] × [T]
+comparison is vectorized into a single fused XLA kernel — states stay
+O(C × T), fully static shapes, jit/shard_map native.
+"""
+from typing import Any, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.average_precision import (
+    _average_precision_compute_with_precision_recall,
+)
+from metrics_tpu.utils.data import METRIC_EPS, to_onehot
+
+
+def _recall_at_precision(
+    precision: Array, recall: Array, thresholds: Array, min_precision: float
+) -> Tuple[Array, Array]:
+    """Highest recall (and its threshold) where precision >= min_precision.
+
+    Ties broken like the reference's lexicographic ``max((r, p, t))``
+    (``binned_precision_recall.py:25-42``): max recall, then max precision,
+    then max threshold — expressed as three staged reductions so it jits.
+    """
+    n = thresholds.shape[0]
+    prec, rec = precision[:n], recall[:n]
+    ok = prec >= min_precision
+    max_recall = jnp.max(jnp.where(ok, rec, -1.0))
+    tie = ok & (rec == max_recall)
+    max_prec = jnp.max(jnp.where(tie, prec, -1.0))
+    tie = tie & (prec == max_prec)
+    best_threshold = jnp.max(jnp.where(tie, thresholds, -jnp.inf))
+    max_recall = jnp.maximum(max_recall, 0.0)
+    best_threshold = jnp.where(
+        max_recall == 0.0, jnp.asarray(1e6, dtype=thresholds.dtype), best_threshold
+    ).astype(thresholds.dtype)
+    return max_recall, best_threshold
+
+
+class BinnedPrecisionRecallCurve(Metric):
+    """Constant-memory PR curve over fixed threshold bins."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Union[int, Array, List[float], None] = 100,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+        self.num_classes = num_classes
+        if isinstance(thresholds, int):
+            self.num_thresholds = thresholds
+            self.thresholds = jnp.linspace(0, 1.0, thresholds)
+        elif thresholds is not None:
+            if not isinstance(thresholds, (list, jnp.ndarray)):
+                raise ValueError("Expected argument `thresholds` to either be an integer, list of floats or a tensor")
+            self.thresholds = jnp.asarray(thresholds, dtype=jnp.float32)
+            self.num_thresholds = self.thresholds.size
+        else:
+            raise ValueError("Expected argument `thresholds` to either be an integer, list of floats or a tensor")
+
+        for name in ("TPs", "FPs", "FNs"):
+            self.add_state(
+                name,
+                default=jnp.zeros((num_classes, self.num_thresholds), dtype=jnp.float32),
+                dist_reduce_fx="sum",
+            )
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        """[N] or [N, C] probabilities vs integer / one-hot targets."""
+        if preds.ndim == target.ndim == 1:
+            preds = preds.reshape(-1, 1)
+            target = target.reshape(-1, 1)
+        if preds.ndim == target.ndim + 1:
+            target = to_onehot(target, num_classes=self.num_classes)
+        target = target == 1
+        # [N, C, T] comparison fused by XLA; sums land in [C, T] states
+        predictions = preds[:, :, None] >= self.thresholds[None, None, :]
+        t = target[:, :, None]
+        self.TPs = self.TPs + jnp.sum(t & predictions, axis=0)
+        self.FPs = self.FPs + jnp.sum(~t & predictions, axis=0)
+        self.FNs = self.FNs + jnp.sum(t & ~predictions, axis=0)
+
+    def compute(
+        self,
+    ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+        precisions = (self.TPs + METRIC_EPS) / (self.TPs + self.FPs + METRIC_EPS)
+        recalls = self.TPs / (self.TPs + self.FNs + METRIC_EPS)
+        # guarantee the curve ends at precision=1, recall=0
+        precisions = jnp.concatenate([precisions, jnp.ones((self.num_classes, 1), dtype=precisions.dtype)], axis=1)
+        recalls = jnp.concatenate([recalls, jnp.zeros((self.num_classes, 1), dtype=recalls.dtype)], axis=1)
+        if self.num_classes == 1:
+            return precisions[0, :], recalls[0, :], self.thresholds
+        return list(precisions), list(recalls), [self.thresholds for _ in range(self.num_classes)]
+
+
+class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
+    """Constant-memory average precision from binned PR pairs."""
+
+    def compute(self) -> Union[List[Array], Array]:  # type: ignore[override]
+        precisions, recalls, _ = super().compute()
+        return _average_precision_compute_with_precision_recall(
+            precisions, recalls, self.num_classes, average=None
+        )
+
+
+class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
+    """Highest recall at a minimum precision, from binned PR pairs."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        min_precision: float,
+        thresholds: Union[int, Array, List[float], None] = 100,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes,
+            thresholds=thresholds,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+        self.min_precision = min_precision
+
+    def compute(self) -> Tuple[Array, Array]:  # type: ignore[override]
+        precisions, recalls, thresholds = super().compute()
+        if self.num_classes == 1:
+            return _recall_at_precision(precisions, recalls, thresholds, self.min_precision)
+        recalls_at_p = []
+        thresholds_at_p = []
+        for i in range(self.num_classes):
+            r, t = _recall_at_precision(precisions[i], recalls[i], thresholds[i], self.min_precision)
+            recalls_at_p.append(r)
+            thresholds_at_p.append(t)
+        return jnp.stack(recalls_at_p), jnp.stack(thresholds_at_p)

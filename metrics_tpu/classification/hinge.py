@@ -1,0 +1,53 @@
+"""Hinge module metric.
+
+Behavioral analogue of the reference's
+``torchmetrics/classification/hinge.py`` (130 LoC).
+"""
+from typing import Any, Callable, Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.classification.hinge import (
+    MulticlassMode,
+    _hinge_compute,
+    _hinge_update,
+)
+
+
+class Hinge(Metric):
+    r"""Mean hinge loss for binary, Crammer-Singer or one-vs-all inputs."""
+
+    def __init__(
+        self,
+        squared: bool = False,
+        multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("measure", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0, dtype=jnp.int32), dist_reduce_fx="sum")
+        if multiclass_mode not in (None, MulticlassMode.CRAMMER_SINGER, MulticlassMode.ONE_VS_ALL):
+            raise ValueError(
+                "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+                f"(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL, got {multiclass_mode}."
+            )
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        measure, total = _hinge_update(preds, target, squared=self.squared, multiclass_mode=self.multiclass_mode)
+        self.measure = measure + self.measure
+        self.total = total + self.total
+
+    def compute(self) -> Array:
+        return _hinge_compute(self.measure, self.total)

@@ -13,3 +13,11 @@ from metrics_tpu.functional.classification.precision_recall import (
 )
 from metrics_tpu.functional.classification.specificity import specificity
 from metrics_tpu.functional.classification.stat_scores import stat_scores
+from metrics_tpu.functional.classification.auc import auc
+from metrics_tpu.functional.classification.auroc import auroc
+from metrics_tpu.functional.classification.average_precision import average_precision
+from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve
+from metrics_tpu.functional.classification.roc import roc
+from metrics_tpu.functional.classification.calibration_error import calibration_error
+from metrics_tpu.functional.classification.hinge import hinge
+from metrics_tpu.functional.classification.kl_divergence import kl_divergence

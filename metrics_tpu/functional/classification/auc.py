@@ -1,0 +1,52 @@
+"""Generic area under a curve (trapezoidal) — functional layer.
+
+Behavioral analogue of the reference's
+``torchmetrics/functional/classification/auc.py:20-133``.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _auc_update(x: Array, y: Array) -> Tuple[Array, Array]:
+    if x.ndim > 1:
+        x = x.squeeze()
+    if y.ndim > 1:
+        y = y.squeeze()
+    if x.ndim > 1 or y.ndim > 1:
+        raise ValueError(
+            f"Expected both `x` and `y` tensor to be 1d, but got tensors with dimension {x.ndim} and {y.ndim}"
+        )
+    if x.size != y.size:
+        raise ValueError(
+            f"Expected the same number of elements in `x` and `y` tensor but received {x.size} and {y.size}"
+        )
+    return x, y
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float) -> Array:
+    return jnp.trapezoid(y, x) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    if reorder:
+        order = jnp.argsort(x)
+        x, y = x[order], y[order]
+    dx = x[1:] - x[:-1]
+    if (dx < 0).any():
+        if (dx <= 0).all():
+            direction = -1.0
+        else:
+            raise ValueError(
+                "The `x` array is neither increasing or decreasing. Try setting the reorder argument to `True`."
+            )
+    else:
+        direction = 1.0
+    return _auc_compute_without_check(x, y, direction)
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Trapezoidal area under the (x, y) curve."""
+    x, y = _auc_update(x, y)
+    return _auc_compute(x, y, reorder=reorder)

@@ -1,0 +1,86 @@
+"""Top-label calibration error (ECE / RMSCE / MCE) — functional layer.
+
+Behavioral analogue of the reference's
+``torchmetrics/functional/classification/calibration_error.py:23-156``. The
+reference bins with a python loop over bin boundaries (``_ce_compute``); here
+binning is one vectorized bucketize + masked segment-mean — jit-safe and fused
+by XLA.
+"""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import DataType
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Array,
+    norm: str = "l1",
+    debias: bool = False,
+) -> Array:
+    """Calibration error over (lower, upper] confidence bins."""
+    if norm not in {"l1", "l2", "max"}:
+        raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+
+    n_bins = bin_boundaries.shape[0] - 1
+    # bin i is (b_i, b_{i+1}]; confidences exactly 0 fall in no bin
+    # (reference semantics: `gt(lower) * le(upper)`, calibration_error.py:54)
+    idx = jnp.searchsorted(bin_boundaries, confidences, side="left") - 1
+    onehot = (idx[:, None] == jnp.arange(n_bins)[None, :]) & (idx >= 0)[:, None]  # [N, B]
+    count_bin = jnp.sum(onehot, axis=0).astype(jnp.float32)
+    safe_count = jnp.where(count_bin == 0, 1.0, count_bin)
+    conf_bin = jnp.sum(onehot * confidences[:, None], axis=0) / safe_count
+    acc_bin = jnp.sum(onehot * accuracies[:, None], axis=0) / safe_count
+    prop_bin = count_bin / confidences.shape[0]
+    conf_bin = jnp.where(count_bin == 0, 0.0, conf_bin)
+    acc_bin = jnp.where(count_bin == 0, 0.0, acc_bin)
+
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    # l2
+    ce = jnp.sum((acc_bin - conf_bin) ** 2 * prop_bin)
+    if debias:
+        debias_bins = (acc_bin * (acc_bin - 1) * prop_bin) / (
+            prop_bin * accuracies.shape[0] - 1
+        )
+        ce = ce + jnp.sum(jnp.nan_to_num(debias_bins))
+    return jnp.where(ce > 0, jnp.sqrt(jnp.maximum(ce, 0.0)), 0.0)
+
+
+def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-1 confidences and their correctness, per input mode."""
+    _, _, mode = _input_format_classification(preds, target)
+
+    if mode == DataType.BINARY:
+        confidences, accuracies = preds, target
+    elif mode == DataType.MULTICLASS:
+        confidences = jnp.max(preds, axis=1)
+        predictions = jnp.argmax(preds, axis=1)
+        accuracies = predictions == target
+    elif mode == DataType.MULTIDIM_MULTICLASS:
+        flat = jnp.swapaxes(preds, 1, -1).reshape(-1, preds.shape[1])
+        confidences = jnp.max(flat, axis=1)
+        predictions = jnp.argmax(flat, axis=1)
+        accuracies = predictions == target.ravel()
+    else:
+        raise ValueError(
+            f"Calibration error is not well-defined for data with size {preds.shape} and targets {target.shape}."
+        )
+    return confidences.astype(jnp.float32), accuracies.astype(jnp.float32)
+
+
+def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str = "l1") -> Array:
+    r"""Top-label calibration error (L1 = ECE, L2 = RMSCE, max = MCE)."""
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
+    if not isinstance(n_bins, int) or n_bins <= 0:
+        raise ValueError(f"Expected argument `n_bins` to be a int larger than 0 but got {n_bins}")
+    confidences, accuracies = _ce_update(preds, target)
+    bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
+    return _ce_compute(confidences, accuracies, bin_boundaries, norm=norm)

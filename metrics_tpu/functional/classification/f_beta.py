@@ -66,6 +66,8 @@ def _fbeta_compute(
         denom = jnp.where(mask, -1.0, denom)
 
     if average == AvgMethod.MACRO and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        # == -3 catches rows flagged with the -1 sentinel by _stat_scores_update
+        # when ignore_index is set with reduce='macro'
         cond = ((tp + fp + fn) == 0) | ((tp + fp + fn) == -3)
         num = jnp.where(cond, -1.0, num)
         denom = jnp.where(cond, -1.0, denom)

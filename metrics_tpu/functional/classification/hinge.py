@@ -1,0 +1,94 @@
+"""Hinge loss (binary, Crammer-Singer, one-vs-all) — functional layer.
+
+Behavioral analogue of the reference's
+``torchmetrics/functional/classification/hinge.py:24-230``, with the boolean
+mask-assignment rewritten as ``where`` selects (jit-safe, fused).
+"""
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _input_squeeze
+from metrics_tpu.utils.data import to_onehot
+from metrics_tpu.utils.enums import DataType, EnumStr
+
+
+class MulticlassMode(EnumStr):
+    """Multiclass hinge flavors."""
+
+    CRAMMER_SINGER = "crammer-singer"
+    ONE_VS_ALL = "one-vs-all"
+
+
+def _check_shape_and_type_consistency_hinge(preds: Array, target: Array) -> DataType:
+    if target.ndim > 1:
+        raise ValueError(f"The `target` should be one dimensional, got `target` with shape={target.shape}.")
+    if preds.ndim == 1:
+        if preds.shape != target.shape:
+            raise ValueError(
+                f"The `preds` and `target` should have the same shape, got `preds` with shape={preds.shape}"
+                f" and `target` with shape={target.shape}."
+            )
+        mode = DataType.BINARY
+    elif preds.ndim == 2:
+        if preds.shape[0] != target.shape[0]:
+            raise ValueError(
+                f"The `preds` and `target` should have the same shape in the first dimension, got `preds` with"
+                f" shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        mode = DataType.MULTICLASS
+    else:
+        raise ValueError(f"The `preds` should be one or two dimensional, got `preds` with shape={preds.shape}.")
+    return mode
+
+
+def _hinge_update(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Tuple[Array, Array]:
+    """Sum of hinge measures over the batch, plus the sample count."""
+    preds, target = _input_squeeze(preds, target)
+    mode = _check_shape_and_type_consistency_hinge(preds, target)
+
+    if mode == DataType.MULTICLASS:
+        target_onehot = to_onehot(target, max(2, preds.shape[1])).astype(bool)
+
+    if mode == DataType.MULTICLASS and (
+        multiclass_mode is None or multiclass_mode == MulticlassMode.CRAMMER_SINGER
+    ):
+        own = jnp.sum(jnp.where(target_onehot, preds, 0.0), axis=1)
+        best_other = jnp.max(jnp.where(target_onehot, -jnp.inf, preds), axis=1)
+        margin = own - best_other
+    elif mode == DataType.BINARY:
+        margin = jnp.where(target.astype(bool), preds, -preds)
+    elif multiclass_mode == MulticlassMode.ONE_VS_ALL:
+        margin = jnp.where(target_onehot, preds, -preds)
+    else:
+        raise ValueError(
+            "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+            f"(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL, got {multiclass_mode}."
+        )
+
+    measures = jnp.clip(1 - margin, 0, None)
+    if squared:
+        measures = measures ** 2
+    total = jnp.asarray(target.shape[0])
+    return jnp.sum(measures, axis=0), total
+
+
+def _hinge_compute(measure: Array, total: Array) -> Array:
+    return measure / total
+
+
+def hinge(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Array:
+    r"""Mean hinge loss :math:`\max(0, 1 - margin)`, typically for SVMs."""
+    measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
+    return _hinge_compute(measure, total)

@@ -1,0 +1,154 @@
+"""Precision-recall curve — functional layer.
+
+Behavioral analogue of the reference's
+``torchmetrics/functional/classification/precision_recall_curve.py:23-334``.
+Curve outputs are inherently dynamic-shape (one point per distinct threshold),
+so these run eagerly at compute() time; the jit/constant-memory alternative is
+the Binned* family (``metrics_tpu/classification/binned_precision_recall.py``),
+which the TPU build treats as the preferred hot-path design.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """Cumulative fps/tps per distinct score threshold (descending).
+
+    Same contract as the reference's ``_binary_clf_curve``
+    (``precision_recall_curve.py:23-61``, itself following sklearn's
+    ``_ranking.py``): argsort + cumsum, deduplicated at distinct values.
+    """
+    if sample_weights is not None and not isinstance(sample_weights, jnp.ndarray):
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    desc = jnp.argsort(-preds)
+    preds = preds[desc]
+    target = target[desc]
+    weight = sample_weights[desc] if sample_weights is not None else 1.0
+
+    distinct_idx = jnp.nonzero(preds[1:] - preds[:-1])[0]
+    threshold_idxs = jnp.concatenate(
+        [distinct_idx, jnp.asarray([target.shape[0] - 1], dtype=distinct_idx.dtype)]
+    )
+    target = (target == pos_label).astype(jnp.int32)
+    tps = jnp.cumsum(target * weight, axis=0)[threshold_idxs]
+    if sample_weights is not None:
+        fps = jnp.cumsum((1 - target) * weight, axis=0)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+    return fps, tps, preds[threshold_idxs]
+
+
+def _precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[Array, Array, int, Optional[int]]:
+    """Normalize inputs to (flat) binary / [N', C] layout."""
+    if preds.ndim == target.ndim:
+        if pos_label is None:
+            rank_zero_warn("`pos_label` automatically set 1.")
+            pos_label = 1
+        if num_classes is not None and num_classes != 1:
+            if num_classes != preds.shape[1]:
+                raise ValueError(
+                    f"Argument `num_classes` was set to {num_classes} in metric"
+                    f" `precision_recall_curve` but detected {preds.shape[1]} number of classes from predictions"
+                )
+            preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).swapaxes(0, 1)
+            target = jnp.swapaxes(target, 0, 1).reshape(num_classes, -1).swapaxes(0, 1)
+        else:
+            preds = preds.ravel()
+            target = target.ravel()
+            num_classes = 1
+    elif preds.ndim == target.ndim + 1:
+        if pos_label is not None:
+            rank_zero_warn(
+                f"Argument `pos_label` should be `None` when running multiclass precision recall curve. Got {pos_label}"
+            )
+        if num_classes != preds.shape[1]:
+            raise ValueError(
+                f"Argument `num_classes` was set to {num_classes} in metric"
+                f" `precision_recall_curve` but detected {preds.shape[1]} number of classes from predictions"
+            )
+        preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).swapaxes(0, 1)
+        target = target.ravel()
+    else:
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+    return preds, target, num_classes, pos_label
+
+
+def _precision_recall_curve_compute_single_class(
+    preds: Array,
+    target: Array,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[Array, Array, Array]:
+    fps, tps, thresholds = _binary_clf_curve(preds, target, sample_weights, pos_label)
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+
+    # stop when full recall attained, reverse so recall is decreasing
+    last_ind = int(jnp.nonzero(tps == tps[-1])[0][0])
+    sl = slice(0, last_ind + 1)
+    precision = jnp.concatenate([precision[sl][::-1], jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([recall[sl][::-1], jnp.zeros(1, dtype=recall.dtype)])
+    thresholds = thresholds[sl][::-1]
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute_multi_class(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[Array], List[Array], List[Array]]:
+    precision, recall, thresholds = [], [], []
+    for cls in range(num_classes):
+        preds_cls = preds[:, cls]
+        prc_args = dict(preds=preds_cls, target=target, num_classes=1, pos_label=cls, sample_weights=sample_weights)
+        if target.ndim > 1:
+            prc_args.update(dict(target=target[:, cls], pos_label=1))
+        res = precision_recall_curve(**prc_args)
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+    return precision, recall, thresholds
+
+
+def _precision_recall_curve_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if num_classes == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _precision_recall_curve_compute_single_class(preds, target, pos_label, sample_weights)
+    return _precision_recall_curve_compute_multi_class(preds, target, num_classes, sample_weights)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Precision-recall pairs for all distinct thresholds (eager, exact)."""
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -1,0 +1,82 @@
+"""ROC curve — functional layer.
+
+Behavioral analogue of the reference's
+``torchmetrics/functional/classification/roc.py:24-273``.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _precision_recall_curve_update,
+)
+
+_roc_update = _precision_recall_curve_update
+
+
+def _roc_compute_single_class(
+    preds: Array,
+    target: Array,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[Array, Array, Array]:
+    fps, tps, thresholds = _binary_clf_curve(preds, target, sample_weights, pos_label)
+    # prepend a point so the curve starts at (0, 0)
+    tps = jnp.concatenate([jnp.zeros(1, dtype=tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, dtype=fps.dtype), fps])
+    thresholds = jnp.concatenate([thresholds[0][None] + 1, thresholds])
+
+    if fps[-1] <= 0:
+        raise ValueError("No negative samples in targets, false positive value should be meaningless")
+    fpr = fps / fps[-1]
+    if tps[-1] <= 0:
+        raise ValueError("No positive samples in targets, true positive value should be meaningless")
+    tpr = tps / tps[-1]
+    return fpr, tpr, thresholds
+
+
+def _roc_compute_multi_class(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Tuple[List[Array], List[Array], List[Array]]:
+    fpr, tpr, thresholds = [], [], []
+    for cls in range(num_classes):
+        if preds.shape == target.shape:
+            target_cls, pos_label = target[:, cls], 1
+        else:
+            target_cls, pos_label = target, cls
+        res = roc(preds[:, cls], target_cls, num_classes=1, pos_label=pos_label, sample_weights=sample_weights)
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds.append(res[2])
+    return fpr, tpr, thresholds
+
+
+def _roc_compute(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if num_classes == 1 and preds.ndim == 1:
+        if pos_label is None:
+            pos_label = 1
+        return _roc_compute_single_class(preds, target, pos_label, sample_weights)
+    return _roc_compute_multi_class(preds, target, num_classes, sample_weights)
+
+
+def roc(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """(fpr, tpr, thresholds) — per class lists for multiclass/multilabel."""
+    preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
+    return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -1,0 +1,195 @@
+"""Curve family (PR-curve, ROC, AUROC, AP, AUC, Binned*) parity vs sklearn."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    average_precision_score as sk_average_precision,
+    precision_recall_curve as sk_precision_recall_curve,
+    roc_auc_score as sk_roc_auc,
+    roc_curve as sk_roc_curve,
+)
+
+from metrics_tpu import (
+    AUC,
+    AUROC,
+    AveragePrecision,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+    BinnedRecallAtFixedPrecision,
+    PrecisionRecallCurve,
+    ROC,
+)
+from metrics_tpu.functional import auc, auroc, average_precision, precision_recall_curve, roc
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass_prob,
+    _input_multilabel_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+class TestBinaryCurves(MetricTester):
+    atol = 1e-6
+
+    def test_roc_binary_fn(self):
+        preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+        fpr, tpr, thr = roc(jnp.asarray(preds), jnp.asarray(target), pos_label=1)
+        sk_fpr, sk_tpr, sk_thr = sk_roc_curve(target, preds, drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+    def test_prc_binary_fn(self):
+        preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+        p, r, t = precision_recall_curve(jnp.asarray(preds), jnp.asarray(target), pos_label=1)
+        sk_p, sk_r, sk_t = sk_precision_recall_curve(target, preds)
+        # the reference truncates the full-recall plateau to its last point
+        # (torchmetrics precision_recall_curve.py:146-149); sklearn >=1.0 keeps
+        # the whole plateau, so our curve equals sklearn's tail
+        off = len(sk_p) - len(np.asarray(p))
+        np.testing.assert_allclose(np.asarray(p), sk_p[off:], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(r), sk_r[off:], atol=1e-6)
+        np.testing.assert_allclose(np.asarray(t), sk_t[off:], atol=1e-6)
+
+    def test_auroc_binary_fn(self):
+        preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+        result = auroc(jnp.asarray(preds), jnp.asarray(target), pos_label=1)
+        np.testing.assert_allclose(np.asarray(result), sk_roc_auc(target, preds), atol=1e-6)
+
+    def test_auroc_binary_max_fpr(self):
+        preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+        result = auroc(jnp.asarray(preds), jnp.asarray(target), pos_label=1, max_fpr=0.5)
+        np.testing.assert_allclose(np.asarray(result), sk_roc_auc(target, preds, max_fpr=0.5), atol=1e-6)
+
+    def test_ap_binary_fn(self):
+        preds, target = _input_binary_prob.preds[0], _input_binary_prob.target[0]
+        result = average_precision(jnp.asarray(preds), jnp.asarray(target), pos_label=1)
+        np.testing.assert_allclose(np.asarray(result), sk_average_precision(target, preds), atol=1e-6)
+
+    def test_auc_fn(self):
+        x = jnp.asarray([0, 1, 2, 3])
+        y = jnp.asarray([0, 1, 2, 2])
+        np.testing.assert_allclose(np.asarray(auc(x, y)), 4.0, atol=1e-6)
+        # decreasing x
+        np.testing.assert_allclose(np.asarray(auc(x[::-1], y[::-1])), -4.0 * -1, atol=1e-6)
+
+    @pytest.mark.parametrize("metric_class, sk_fn", [
+        (AUROC, sk_roc_auc),
+        (AveragePrecision, sk_average_precision),
+    ])
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_binary_class(self, metric_class, sk_fn, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_input_binary_prob.preds,
+            target=_input_binary_prob.target,
+            metric_class=metric_class,
+            sk_metric=lambda p, t: sk_fn(t, p),
+            metric_args={"pos_label": 1},
+            check_jit=False,  # cat-state curves are eager-only by design
+        )
+
+    def test_auroc_sharded(self):
+        self.run_sharded_metric_test(
+            preds=_input_binary_prob.preds,
+            target=_input_binary_prob.target,
+            metric_class=AUROC,
+            sk_metric=lambda p, t: sk_roc_auc(t, p),
+            metric_args={"pos_label": 1},
+        )
+
+
+class TestMulticlassCurves(MetricTester):
+    atol = 1e-6
+
+    def test_auroc_multiclass(self):
+        preds = np.concatenate(list(_input_multiclass_prob.preds))
+        target = np.concatenate(list(_input_multiclass_prob.target))
+        result = auroc(jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES)
+        expected = sk_roc_auc(target, preds, multi_class="ovr", average="macro")
+        np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+    def test_auroc_multilabel(self):
+        preds = np.concatenate(list(_input_multilabel_prob.preds))
+        target = np.concatenate(list(_input_multilabel_prob.target))
+        result = auroc(jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES)
+        expected = sk_roc_auc(target, preds, average="macro")
+        np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5)
+
+    def test_ap_multiclass(self):
+        preds = np.concatenate(list(_input_multiclass_prob.preds))
+        target = np.concatenate(list(_input_multiclass_prob.target))
+        result = average_precision(
+            jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES, average=None
+        )
+        onehot = np.eye(NUM_CLASSES)[target]
+        for c in range(NUM_CLASSES):
+            np.testing.assert_allclose(
+                np.asarray(result[c]), sk_average_precision(onehot[:, c], preds[:, c]), atol=1e-6
+            )
+
+    def test_roc_multiclass(self):
+        preds = _input_multiclass_prob.preds[0]
+        target = _input_multiclass_prob.target[0]
+        fprs, tprs, _ = roc(jnp.asarray(preds), jnp.asarray(target), num_classes=NUM_CLASSES)
+        for c in range(NUM_CLASSES):
+            sk_fpr, sk_tpr, _ = sk_roc_curve((target == c).astype(int), preds[:, c], drop_intermediate=False)
+            np.testing.assert_allclose(np.asarray(fprs[c]), sk_fpr, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(tprs[c]), sk_tpr, atol=1e-6)
+
+
+class TestBinned(MetricTester):
+    def test_binned_pr_curve_approaches_exact(self):
+        """With fine bins, binned AP ~= exact AP."""
+        preds = np.concatenate(list(_input_binary_prob.preds))
+        target = np.concatenate(list(_input_binary_prob.target))
+        m = BinnedAveragePrecision(num_classes=1, thresholds=1001)
+        m.update(jnp.asarray(preds), jnp.asarray(target))
+        result = m.compute()
+        expected = sk_average_precision(target, preds)
+        np.testing.assert_allclose(np.asarray(result), expected, atol=2e-2)
+
+    def test_binned_pr_curve_reference_values(self):
+        """Reference doctest values (binned_precision_recall.py:65-75)."""
+        pred = jnp.asarray([0, 0.1, 0.8, 0.4])
+        target = jnp.asarray([0, 1, 1, 0])
+        m = BinnedPrecisionRecallCurve(num_classes=1, thresholds=5)
+        precision, recall, thresholds = m(pred, target)
+        np.testing.assert_allclose(np.asarray(precision), [0.5, 0.5, 1.0, 1.0, 1.0, 1.0], atol=1e-4)
+        np.testing.assert_allclose(np.asarray(recall), [1.0, 0.5, 0.5, 0.5, 0.0, 0.0], atol=1e-4)
+        np.testing.assert_allclose(np.asarray(thresholds), [0.0, 0.25, 0.5, 0.75, 1.0], atol=1e-6)
+
+    def test_binned_recall_at_precision(self):
+        pred = jnp.asarray([0, 0.2, 0.5, 0.8])
+        target = jnp.asarray([0, 1, 1, 0])
+        m = BinnedRecallAtFixedPrecision(num_classes=1, thresholds=10, min_precision=0.5)
+        recall, threshold = m(pred, target)
+        np.testing.assert_allclose(np.asarray(recall), 1.0, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(threshold), 1 / 9, atol=1e-4)
+
+    def test_binned_is_jittable(self):
+        """The binned family's whole update+compute must jit (the TPU path)."""
+        import jax
+
+        m = BinnedPrecisionRecallCurve(num_classes=NUM_CLASSES, thresholds=50)
+        state = m.init_state()
+        step = jax.jit(m.pure_update)
+        for i in range(3):
+            state = step(
+                state,
+                jnp.asarray(_input_multiclass_prob.preds[i]),
+                jnp.asarray(_input_multiclass_prob.target[i]),
+            )
+        p, r, t = jax.jit(lambda s: m.pure_compute(s))(state)
+        assert len(p) == NUM_CLASSES
+
+    def test_binned_ap_multiclass_parity(self):
+        preds = np.concatenate(list(_input_multiclass_prob.preds))
+        target = np.concatenate(list(_input_multiclass_prob.target))
+        m = BinnedAveragePrecision(num_classes=NUM_CLASSES, thresholds=1001)
+        m.update(jnp.asarray(preds), jnp.asarray(target))
+        result = m.compute()
+        onehot = np.eye(NUM_CLASSES)[target]
+        for c in range(NUM_CLASSES):
+            np.testing.assert_allclose(
+                np.asarray(result[c]), sk_average_precision(onehot[:, c], preds[:, c]), atol=5e-2
+            )

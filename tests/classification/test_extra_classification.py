@@ -1,0 +1,138 @@
+"""CalibrationError / Hinge / KLDivergence parity tests."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import entropy as scipy_entropy
+from sklearn.metrics import hinge_loss as sk_hinge_loss
+
+from metrics_tpu import CalibrationError, Hinge, KLDivergence
+from metrics_tpu.functional import calibration_error, hinge, kl_divergence
+from tests.classification.inputs import (
+    _input_binary_logits,
+    _input_binary_prob,
+    _input_multiclass_logits,
+    _input_multiclass_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+def _np_calibration_error(confidences, accuracies, n_bins=15, norm="l1"):
+    """Direct numpy replica of the reference's per-bin loop."""
+    bins = np.linspace(0, 1, n_bins + 1)
+    conf_b, acc_b, prop_b = np.zeros(n_bins), np.zeros(n_bins), np.zeros(n_bins)
+    for i in range(n_bins):
+        in_bin = (confidences > bins[i]) & (confidences <= bins[i + 1])
+        if in_bin.mean() > 0:
+            acc_b[i] = accuracies[in_bin].mean()
+            conf_b[i] = confidences[in_bin].mean()
+            prop_b[i] = in_bin.mean()
+    if norm == "l1":
+        return np.sum(np.abs(acc_b - conf_b) * prop_b)
+    if norm == "max":
+        return np.max(np.abs(acc_b - conf_b))
+    ce = np.sum((acc_b - conf_b) ** 2 * prop_b)
+    return np.sqrt(ce) if ce > 0 else 0.0
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+def test_calibration_error_multiclass(norm):
+    preds = np.concatenate(list(_input_multiclass_prob.preds))
+    target = np.concatenate(list(_input_multiclass_prob.target))
+    result = calibration_error(jnp.asarray(preds), jnp.asarray(target), norm=norm)
+    conf, acc = preds.max(1), (preds.argmax(1) == target).astype(float)
+    expected = _np_calibration_error(conf, acc, norm=norm)
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+
+@pytest.mark.parametrize("norm", ["l1", "max"])
+def test_calibration_error_binary(norm):
+    preds = np.concatenate(list(_input_binary_prob.preds))
+    target = np.concatenate(list(_input_binary_prob.target))
+    result = calibration_error(jnp.asarray(preds), jnp.asarray(target), norm=norm)
+    expected = _np_calibration_error(preds, target.astype(float), norm=norm)
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+
+def test_calibration_error_module_matches_fn():
+    m = CalibrationError(n_bins=15, norm="l1")
+    for i in range(3):
+        m.update(
+            jnp.asarray(_input_multiclass_prob.preds[i]), jnp.asarray(_input_multiclass_prob.target[i])
+        )
+    preds = np.concatenate([_input_multiclass_prob.preds[i] for i in range(3)])
+    target = np.concatenate([_input_multiclass_prob.target[i] for i in range(3)])
+    expected = calibration_error(jnp.asarray(preds), jnp.asarray(target), norm="l1")
+    np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(expected), atol=1e-6)
+
+
+def test_hinge_binary_vs_sklearn():
+    preds = np.concatenate(list(_input_binary_logits.preds))
+    target = np.concatenate(list(_input_binary_logits.target))
+    result = hinge(jnp.asarray(preds), jnp.asarray(target))
+    expected = sk_hinge_loss(target, preds)
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5)
+
+
+def test_hinge_multiclass_crammer_singer_vs_sklearn():
+    preds = np.concatenate(list(_input_multiclass_logits.preds))
+    target = np.concatenate(list(_input_multiclass_logits.target))
+    result = hinge(jnp.asarray(preds), jnp.asarray(target))
+    expected = sk_hinge_loss(target, preds, labels=list(range(NUM_CLASSES)))
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5)
+
+
+def test_hinge_one_vs_all_reference_values():
+    # reference doctest (functional/classification/hinge.py:141-147)
+    target = jnp.asarray([0, 1, 2])
+    preds = jnp.asarray([[-1.0, 0.9, 0.2], [0.5, -1.1, 0.8], [2.2, -0.5, 0.3]])
+    result = hinge(preds, target, multiclass_mode="one-vs-all")
+    np.testing.assert_allclose(np.asarray(result), [2.2333, 1.5, 1.2333], atol=1e-3)
+
+
+def test_hinge_class_ddp():
+    tester = MetricTester()
+    tester.atol = 1e-5
+    tester.run_class_metric_test(
+        ddp=True,
+        preds=_input_multiclass_logits.preds,
+        target=_input_multiclass_logits.target,
+        metric_class=Hinge,
+        sk_metric=lambda p, t: sk_hinge_loss(t, p, labels=list(range(NUM_CLASSES))),
+        metric_args={},
+    )
+
+
+def test_kl_divergence_vs_scipy():
+    p = np.abs(np.random.RandomState(7).randn(32, 8)) + 0.1
+    q = np.abs(np.random.RandomState(8).randn(32, 8)) + 0.1
+    result = kl_divergence(jnp.asarray(p), jnp.asarray(q))
+    pn = p / p.sum(1, keepdims=True)
+    qn = q / q.sum(1, keepdims=True)
+    expected = np.mean([scipy_entropy(pn[i], qn[i]) for i in range(32)])
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_kl_divergence_module(reduction):
+    rng = np.random.RandomState(3)
+    m = KLDivergence(reduction=reduction)
+    ps, qs = [], []
+    for _ in range(3):
+        p = jnp.asarray(np.abs(rng.randn(16, 5)) + 0.1)
+        q = jnp.asarray(np.abs(rng.randn(16, 5)) + 0.1)
+        m.update(p, q)
+        ps.append(p)
+        qs.append(q)
+    expected = kl_divergence(jnp.concatenate(ps), jnp.concatenate(qs), reduction=reduction)
+    np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(expected), atol=1e-5)
+
+
+def test_kl_divergence_log_prob():
+    rng = np.random.RandomState(4)
+    logits = rng.randn(16, 5)
+    p_log = jnp.asarray(logits - np.log(np.exp(logits).sum(1, keepdims=True)))
+    q_log = jnp.asarray(np.zeros((16, 5)) - np.log(5.0))
+    result = kl_divergence(p_log, q_log, log_prob=True)
+    p = np.exp(np.asarray(p_log))
+    expected = np.mean(np.sum(p * (np.asarray(p_log) - np.asarray(q_log)), axis=1))
+    np.testing.assert_allclose(np.asarray(result), expected, atol=1e-5)

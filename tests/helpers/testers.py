@@ -226,11 +226,19 @@ class MetricTester:
         t_sh = stride(target)
         kw_sh = {k: stride(np.asarray(v)) for k, v in kwargs_update.items()}
 
+        # metrics with only fixed-shape states run the FULL fused pipeline
+        # (update + collectives + compute) inside the traced program; cat-state
+        # metrics return the synced state and compute eagerly, since their
+        # compute is dynamic-shape by design (curves)
+        fused_compute = not any(isinstance(v, list) for v in metric.init_state().values())
+
         @partial(
             jax.shard_map,
             mesh=mesh,
             in_specs=(P("dp"), P("dp")) + tuple(P("dp") for _ in kw_sh),
             out_specs=P(),
+            check_vma=False,  # all_gather'd cat-states are replicated, but the
+            # static varying-axes check can't always infer it
         )
         def sharded_eval(p, t, *kws):
             state = metric.init_state()
@@ -239,9 +247,10 @@ class MetricTester:
                     state, p[0, i], t[0, i], **{k: kw[0, i] for k, kw in zip(kw_sh, kws)}
                 )
             synced = metric.pure_sync(state, "dp")
-            return metric.pure_compute(synced)
+            return metric.pure_compute(synced) if fused_compute else synced
 
-        result = sharded_eval(p_sh, t_sh, *kw_sh.values())
+        out = sharded_eval(p_sh, t_sh, *kw_sh.values())
+        result = out if fused_compute else metric.pure_compute(out)
         total_preds = np.concatenate([preds[i] for i in range(NUM_BATCHES)], axis=0)
         total_target = np.concatenate([target[i] for i in range(NUM_BATCHES)], axis=0)
         # order across ranks differs from plain concat for cat-states; reference
