@@ -13,6 +13,7 @@ _logger.setLevel(logging.INFO)
 
 __version__ = "0.1.0"
 
+from metrics_tpu.core.average import AverageMeter
 from metrics_tpu.core.collections import MetricCollection
 from metrics_tpu.core.metric import CompositionalMetric, Metric
 from metrics_tpu.classification import (
@@ -40,11 +41,15 @@ from metrics_tpu.classification import (
     Specificity,
     StatScores,
 )
+from metrics_tpu.wrappers import BootStrapper, MetricTracker
 
 __all__ = [
     "AUC",
     "AUROC",
     "Accuracy",
+    "AverageMeter",
+    "BootStrapper",
+    "MetricTracker",
     "AveragePrecision",
     "CalibrationError",
     "Hinge",

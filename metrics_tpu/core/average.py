@@ -1,0 +1,40 @@
+"""AverageMeter — weighted streaming mean.
+
+Behavioral analogue of the reference's ``torchmetrics/average.py:22-109``.
+"""
+from typing import Any, Callable, Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+
+
+class AverageMeter(Metric):
+    """Average of a stream of (optionally weighted) values."""
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        self.add_state("value", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("weight", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, value: Union[Array, float], weight: Union[Array, float] = 1.0) -> None:  # type: ignore[override]
+        """Add observations; ``weight`` broadcasts to ``value``'s shape."""
+        value = jnp.asarray(value, dtype=jnp.float32)
+        weight = jnp.broadcast_to(jnp.asarray(weight, dtype=jnp.float32), value.shape)
+        self.value = self.value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        return self.value / self.weight

@@ -91,12 +91,14 @@ class MetricCollection(dict):
         name = base if self.prefix is None else self.prefix + base
         return name if self.postfix is None else name + self.postfix
 
-    def items(self, keep_base: bool = False) -> Iterable[Tuple[str, Metric]]:  # type: ignore[override]
+    def items(self, keep_base: bool = True) -> Iterable[Tuple[str, Metric]]:  # type: ignore[override]
+        """Default keeps base keys (dict protocol — deepcopy/pickle iterate
+        this); pass ``keep_base=False`` for the prefixed/postfixed view."""
         if keep_base:
             return super().items()
         return [(self._set_name(k), v) for k, v in super().items()]
 
-    def keys(self, keep_base: bool = False) -> Iterable[str]:  # type: ignore[override]
+    def keys(self, keep_base: bool = True) -> Iterable[str]:  # type: ignore[override]
         if keep_base:
             return super().keys()
         return [self._set_name(k) for k in super().keys()]

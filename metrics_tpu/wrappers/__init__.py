@@ -1,0 +1,2 @@
+from metrics_tpu.wrappers.bootstrapping import BootStrapper
+from metrics_tpu.wrappers.tracker import MetricTracker

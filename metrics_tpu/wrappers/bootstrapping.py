@@ -1,0 +1,105 @@
+"""BootStrapper — bootstrapped confidence intervals for any metric.
+
+Behavioral analogue of the reference's
+``torchmetrics/wrappers/bootstrapping.py:25-173``; sampling uses explicit JAX
+PRNG keys (split per update) instead of torch's global generator.
+"""
+from copy import deepcopy
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import apply_to_collection
+
+
+def _bootstrap_sampler(
+    key: Array,
+    size: int,
+    sampling_strategy: str = "poisson",
+) -> Array:
+    """Indices that resample a batch of ``size`` rows with replacement."""
+    if sampling_strategy == "poisson":
+        n = jax.random.poisson(key, 1.0, (size,))
+        return jnp.repeat(jnp.arange(size), n, total_repeat_length=None)
+    if sampling_strategy == "multinomial":
+        return jax.random.randint(key, (size,), 0, size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(Metric):
+    r"""Keeps ``num_bootstraps`` copies of a base metric; every update feeds
+    each copy a with-replacement resampling of the batch."""
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: int = 0,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but recieved {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._key = jax.random.PRNGKey(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
+        """Resample inputs along dim 0 and update every bootstrap copy."""
+        args_sizes = apply_to_collection(args, jnp.ndarray, len)
+        kwargs_sizes = list(apply_to_collection(kwargs, jnp.ndarray, len).values())
+        if len(args_sizes) > 0:
+            size = args_sizes[0]
+        elif len(kwargs_sizes) > 0:
+            size = kwargs_sizes[0]
+        else:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        for idx in range(self.num_bootstraps):
+            self._key, subkey = jax.random.split(self._key)
+            sample_idx = _bootstrap_sampler(subkey, size, sampling_strategy=self.sampling_strategy)
+            new_args = apply_to_collection(args, jnp.ndarray, lambda x: jnp.take(x, sample_idx, axis=0))
+            new_kwargs = apply_to_collection(kwargs, jnp.ndarray, lambda x: jnp.take(x, sample_idx, axis=0))
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Dict with any of mean/std/quantile/raw over the bootstrap copies."""
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output_dict: Dict[str, Array] = {}
+        if self.mean:
+            output_dict["mean"] = jnp.mean(computed_vals, axis=0)
+        if self.std:
+            output_dict["std"] = jnp.std(computed_vals, axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()

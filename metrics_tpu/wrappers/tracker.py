@@ -1,0 +1,71 @@
+"""MetricTracker — track a metric across steps/epochs.
+
+Behavioral analogue of the reference's
+``torchmetrics/wrappers/tracker.py:23-127``.
+"""
+from copy import deepcopy
+from typing import Any, Tuple, Union
+
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+
+
+class MetricTracker(list):
+    """Keeps one metric clone per ``increment()``; exposes best/all values."""
+
+    def __init__(self, metric: Metric, maximize: bool = True) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise TypeError(f"metric arg need to be an instance of a metrics_tpu metric but got {metric}")
+        self._base_metric = metric
+        self.maximize = maximize
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        return len(self)
+
+    def increment(self) -> None:
+        """Start tracking a fresh clone of the base metric."""
+        self._increment_called = True
+        self.append(deepcopy(self._base_metric))
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self[-1](*args, **kwargs)
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        return self.forward(*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self[-1].compute()
+
+    def compute_all(self) -> jnp.ndarray:
+        self._check_for_increment("compute_all")
+        return jnp.stack([metric.compute() for metric in self], axis=0)
+
+    def reset(self) -> None:
+        self[-1].reset()
+
+    def reset_all(self) -> None:
+        for metric in self:
+            metric.reset()
+
+    def best_metric(self, return_step: bool = False) -> Union[float, Tuple[int, float]]:
+        """Best tracked value (and optionally which step produced it)."""
+        vals = self.compute_all()
+        idx = int(jnp.argmax(vals) if self.maximize else jnp.argmin(vals))
+        best = float(vals[idx])
+        if return_step:
+            return best, idx
+        return best
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called")

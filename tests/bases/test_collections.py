@@ -1,0 +1,93 @@
+"""MetricCollection tests — analogue of reference `tests/bases/test_collections.py`."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, MetricCollection, Precision, Recall
+from tests.helpers.testers import DummyMetricDiff, DummyMetricSum
+
+
+def test_from_list_and_naming():
+    mc = MetricCollection([DummyMetricSum(), DummyMetricDiff()])
+    assert set(mc.keys()) == {"DummyMetricSum", "DummyMetricDiff"}
+
+
+def test_duplicate_names_raise():
+    with pytest.raises(ValueError, match="Encountered two metrics both named"):
+        MetricCollection([DummyMetricSum(), DummyMetricSum()])
+
+
+def test_from_dict_and_kwarg_filtering():
+    mc = MetricCollection({"sum": DummyMetricSum(), "diff": DummyMetricDiff()})
+    out = mc(x=jnp.asarray(5.0), y=jnp.asarray(2.0))
+    np.testing.assert_allclose(np.asarray(out["sum"]), 5.0)
+    np.testing.assert_allclose(np.asarray(out["diff"]), -2.0)
+
+
+def test_prefix_postfix():
+    mc = MetricCollection([DummyMetricSum()], prefix="pre_", postfix="_post")
+    out = mc(x=jnp.asarray(1.0))
+    assert list(out.keys()) == ["pre_DummyMetricSum_post"]
+    mc2 = mc.clone(prefix="new_")
+    out2 = mc2(x=jnp.asarray(1.0))
+    assert list(out2.keys()) == ["new_DummyMetricSum_post"]
+
+
+def test_update_compute_reset():
+    mc = MetricCollection({"sum": DummyMetricSum(), "diff": DummyMetricDiff()})
+    mc.update(x=jnp.asarray(2.0), y=jnp.asarray(3.0))
+    mc.update(x=jnp.asarray(1.0), y=jnp.asarray(1.0))
+    out = mc.compute()
+    np.testing.assert_allclose(np.asarray(out["sum"]), 3.0)
+    np.testing.assert_allclose(np.asarray(out["diff"]), -4.0)
+    mc.reset()
+    np.testing.assert_allclose(np.asarray(mc["sum"].x), 0.0)
+
+
+def test_invalid_inputs():
+    with pytest.raises(ValueError):
+        MetricCollection([DummyMetricSum(), "not-a-metric"])
+    with pytest.raises(ValueError):
+        MetricCollection("bogus")
+
+
+def test_real_metrics_shared_update():
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(64, 5).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 5, (64,)))
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=5),
+            "prec_macro": Precision(num_classes=5, average="macro"),
+            "rec_macro": Recall(num_classes=5, average="macro"),
+        }
+    )
+    out = mc(preds, target)
+    assert set(out.keys()) == {"acc", "prec_macro", "rec_macro"}
+
+
+def test_fused_pure_forward():
+    """One jitted program for the whole collection."""
+    import jax
+
+    mc = MetricCollection({"sum": DummyMetricSum(), "diff": DummyMetricDiff()})
+    state = mc.init_state()
+    fused = jax.jit(lambda s, x, y: mc.pure_forward(s, x=x, y=y))
+    state, vals = fused(state, jnp.asarray(2.0), jnp.asarray(1.0))
+    state, vals = fused(state, jnp.asarray(3.0), jnp.asarray(1.0))
+    np.testing.assert_allclose(np.asarray(vals["sum"]), 3.0)
+    final = mc.pure_compute(state)
+    np.testing.assert_allclose(np.asarray(final["sum"]), 5.0)
+    np.testing.assert_allclose(np.asarray(final["diff"]), -2.0)
+
+
+def test_state_dict_roundtrip():
+    mc = MetricCollection({"sum": DummyMetricSum()})
+    mc["sum"].persistent(True)
+    mc.update(x=jnp.asarray(4.0))
+    sd = mc.state_dict()
+    mc2 = MetricCollection({"sum": DummyMetricSum()})
+    mc2.load_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(mc2.compute()["sum"]), 4.0)

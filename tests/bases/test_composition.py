@@ -1,0 +1,132 @@
+"""CompositionalMetric operator tests — analogue of reference
+`tests/bases/test_composition.py` (559 LoC, all 30+ operators)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import CompositionalMetric, Metric
+from tests.helpers.testers import DummyMetricSum
+
+
+class Const(Metric):
+    def __init__(self, val):
+        super().__init__()
+        self.add_state("v", jnp.asarray(float(val)), dist_reduce_fx="sum")
+
+    def update(self):
+        pass
+
+    def compute(self):
+        return self.v
+
+
+def _c(val):
+    m = Const(val)
+    m._update_called = True
+    return m
+
+
+@pytest.mark.parametrize(
+    "op, expected",
+    [
+        (lambda a, b: a + b, 7.0),
+        (lambda a, b: a - b, 3.0),
+        (lambda a, b: a * b, 10.0),
+        (lambda a, b: a / b, 2.5),
+        (lambda a, b: a // b, 2.0),
+        (lambda a, b: a % b, 1.0),
+        (lambda a, b: a ** b, 25.0),
+    ],
+)
+def test_arithmetic_two_metrics(op, expected):
+    res = op(_c(5), _c(2))
+    assert isinstance(res, CompositionalMetric)
+    np.testing.assert_allclose(np.asarray(res.compute()), expected)
+
+
+@pytest.mark.parametrize(
+    "op, expected",
+    [
+        (lambda a: a + 2, 7.0),
+        (lambda a: 2 + a, 7.0),
+        (lambda a: a - 2, 3.0),
+        (lambda a: 7 - a, 2.0),
+        (lambda a: a * 3, 15.0),
+        (lambda a: 3 * a, 15.0),
+        (lambda a: a / 2, 2.5),
+        (lambda a: 10 / a, 2.0),
+        (lambda a: a ** 2, 25.0),
+        (lambda a: 2 ** a, 32.0),
+    ],
+)
+def test_arithmetic_with_scalar(op, expected):
+    res = op(_c(5))
+    np.testing.assert_allclose(np.asarray(res.compute()), expected)
+
+
+@pytest.mark.parametrize(
+    "op, expected",
+    [
+        (lambda a, b: a == b, False),
+        (lambda a, b: a != b, True),
+        (lambda a, b: a < b, False),
+        (lambda a, b: a <= b, False),
+        (lambda a, b: a > b, True),
+        (lambda a, b: a >= b, True),
+    ],
+)
+def test_comparisons(op, expected):
+    res = op(_c(5), _c(2))
+    assert bool(np.asarray(res.compute())) is expected
+
+
+def test_bitwise_ops():
+    a, b = _c(5), _c(3)  # int semantics via int arrays
+    a._state["v"] = jnp.asarray(5)
+    b._state["v"] = jnp.asarray(3)
+    assert int((a & b).compute()) == 1
+    assert int((a | b).compute()) == 7
+    assert int((a ^ b).compute()) == 6
+
+
+def test_unary_ops():
+    m = _c(-5)
+    np.testing.assert_allclose(np.asarray(abs(m).compute()), 5.0)
+    np.testing.assert_allclose(np.asarray((-m).compute()), 5.0)
+
+
+def test_getitem():
+    m = Const(0)
+    m._state["v"] = jnp.asarray([1.0, 2.0, 3.0])
+    m._update_called = True
+    np.testing.assert_allclose(np.asarray(m[1].compute()), 2.0)
+
+
+def test_composition_updates_both_operands():
+    a, b = DummyMetricSum(), DummyMetricSum()
+    comp = a + b
+    comp.update(jnp.asarray(2.0))
+    assert float(a.x) == 2.0
+    assert float(b.x) == 2.0
+    np.testing.assert_allclose(np.asarray(comp.compute()), 4.0)
+
+
+def test_composition_forward():
+    a, b = DummyMetricSum(), DummyMetricSum()
+    comp = a + b
+    v = comp(jnp.asarray(3.0))
+    np.testing.assert_allclose(np.asarray(v), 6.0)
+
+
+def test_composition_reset_propagates():
+    a, b = DummyMetricSum(), DummyMetricSum()
+    comp = a + b
+    comp.update(jnp.asarray(2.0))
+    comp.reset()
+    assert float(a.x) == 0.0
+    assert float(b.x) == 0.0
+
+
+def test_nested_composition():
+    res = (_c(5) + _c(2)) * 2
+    np.testing.assert_allclose(np.asarray(res.compute()), 14.0)

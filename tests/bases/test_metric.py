@@ -1,0 +1,179 @@
+"""Core Metric lifecycle tests — analogue of reference `tests/bases/test_metric.py`."""
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from tests.helpers.testers import DummyListMetric, DummyMetric, DummyMetricSum
+
+
+def test_error_on_wrong_input():
+    with pytest.raises(ValueError, match="state variable must be a jnp array or an empty list"):
+        DummyMetric().add_state("x", "not-an-array")
+    with pytest.raises(ValueError, match="state variable must be a jnp array or an empty list"):
+        DummyMetric().add_state("x", [jnp.zeros(1)])
+    with pytest.raises(ValueError, match="`dist_reduce_fx` must be callable or one of"):
+        DummyMetric().add_state("x", jnp.zeros(()), dist_reduce_fx="bogus")
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state_sets_attribute():
+    m = DummyMetric()
+    assert float(m.x) == 0.0
+    m.x = jnp.asarray(5.0)
+    assert float(m.x) == 5.0
+    assert m._state["x"] == 5.0
+
+
+def test_update_and_reset():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    m.update(jnp.asarray(2.0))
+    assert float(m.x) == 3.0
+    assert m._update_called
+    m.reset()
+    assert float(m.x) == 0.0
+    assert not m._update_called
+
+
+def test_compute_caching():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    assert float(m.compute()) == 1.0
+    m._computed = jnp.asarray(42.0)  # simulate cache
+    assert float(m.compute()) == 42.0  # cached value returned
+    m.update(jnp.asarray(1.0))  # update invalidates cache
+    assert float(m.compute()) == 2.0
+
+
+def test_forward_returns_batch_value_and_accumulates():
+    m = DummyMetricSum()
+    assert float(m(x=jnp.asarray(1.0))) == 1.0
+    assert float(m(x=jnp.asarray(2.0))) == 2.0  # batch-local, not cumulative
+    assert float(m.compute()) == 3.0  # accumulated
+
+
+def test_forward_compute_on_step_false():
+    m = DummyMetricSum(compute_on_step=False)
+    assert m(x=jnp.asarray(1.0)) is None
+    assert float(m.compute()) == 1.0
+
+
+def test_list_state_accumulates():
+    m = DummyListMetric()
+    m.x.append(jnp.asarray([1.0]))
+    m.x.append(jnp.asarray([2.0]))
+    assert len(m.x) == 2
+    m.reset()
+    assert m.x == []
+
+
+def test_reset_defaults_are_isolated():
+    """Resetting one instance must not leak state into another (list default)."""
+    m1, m2 = DummyListMetric(), DummyListMetric()
+    m1.x.append(jnp.asarray([1.0]))
+    assert m2.x == []
+    m1.reset()
+    assert m1.x == []
+
+
+def test_pickle_roundtrip():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(3.0))
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == 3.0
+    m2.update(jnp.asarray(1.0))
+    assert float(m2.compute()) == 4.0
+
+
+def test_state_dict_roundtrip():
+    m = DummyMetricSum()
+    m.persistent(True)
+    m.update(jnp.asarray(7.0))
+    sd = m.state_dict()
+    assert "x" in sd
+    m2 = DummyMetricSum()
+    m2.load_state_dict(sd)
+    assert float(m2.compute()) == 7.0
+
+
+def test_state_dict_skips_non_persistent():
+    m = DummyMetricSum()  # persistent defaults False
+    m.update(jnp.asarray(7.0))
+    assert m.state_dict() == {}
+
+
+def test_clone_is_independent():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    m2 = m.clone()
+    m2.update(jnp.asarray(5.0))
+    assert float(m.x) == 1.0
+    assert float(m2.x) == 6.0
+
+
+def test_merge_state():
+    a, b = DummyMetricSum(), DummyMetricSum()
+    a.update(jnp.asarray(1.0))
+    b.update(jnp.asarray(2.0))
+    a.merge_state(b)
+    assert float(a.compute()) == 3.0
+
+
+def test_sync_state_machine_errors():
+    m = DummyMetricSum()
+    with pytest.raises(MetricsTPUUserError, match="un-synced"):
+        m.unsync()
+    m._is_synced = True
+    with pytest.raises(MetricsTPUUserError, match="synced"):
+        m.update(jnp.asarray(1.0))
+    with pytest.raises(MetricsTPUUserError, match="already been synced"):
+        m.sync()
+    m._is_synced = False
+
+
+def test_hash_changes_with_state():
+    m = DummyMetricSum()
+    h1 = hash(m)
+    m.update(jnp.asarray(1.0))
+    assert hash(m) != h1
+
+
+def test_metric_warns_on_compute_before_update():
+    m = DummyMetricSum()
+    with pytest.warns(UserWarning, match="before the ``update`` method"):
+        m.compute()
+
+
+def test_pure_update_is_jittable_and_stateless():
+    m = DummyMetricSum()
+    step = jax.jit(m.pure_update)
+    s = m.init_state()
+    s = step(s, jnp.asarray(1.0))
+    s = step(s, jnp.asarray(2.0))
+    assert float(m.pure_compute(s)) == 3.0
+    assert float(m.x) == 0.0  # instance state untouched
+
+
+def test_pure_forward_fused():
+    m = DummyMetricSum()
+    s = m.init_state()
+    s, v = m.pure_forward(s, jnp.asarray(2.0))
+    assert float(v) == 2.0
+    s, v = m.pure_forward(s, jnp.asarray(3.0))
+    assert float(v) == 3.0
+    assert float(m.pure_compute(s)) == 5.0
+
+
+def test_set_dtype():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    m.set_dtype(jnp.bfloat16)
+    assert m.x.dtype == jnp.bfloat16

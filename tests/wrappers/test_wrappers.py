@@ -1,0 +1,77 @@
+"""AverageMeter / BootStrapper / MetricTracker tests."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, AverageMeter, BootStrapper, MetricTracker
+from tests.helpers.testers import DummyMetricSum
+
+
+def test_average_meter_simple():
+    avg = AverageMeter()
+    avg.update(3)
+    avg.update(1)
+    np.testing.assert_allclose(np.asarray(avg.compute()), 2.0)
+
+
+def test_average_meter_weighted():
+    avg = AverageMeter()
+    v = avg(jnp.asarray([1.0, 2.0]), jnp.asarray([3.0, 1.0]))
+    np.testing.assert_allclose(np.asarray(v), 1.25)
+
+
+def test_average_meter_vector():
+    avg = AverageMeter()
+    v = avg(jnp.asarray([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(v), 2.0)
+
+
+def test_bootstrapper_accuracy():
+    rng = np.random.RandomState(123)
+    boot = BootStrapper(Accuracy(num_classes=5), num_bootstraps=20, seed=1)
+    boot.update(jnp.asarray(rng.randint(0, 5, (200,))), jnp.asarray(rng.randint(0, 5, (200,))))
+    out = boot.compute()
+    assert set(out.keys()) == {"mean", "std"}
+    # random preds vs random targets -> accuracy ~ 0.2
+    assert abs(float(out["mean"]) - 0.2) < 0.1
+    assert float(out["std"]) > 0
+
+
+def test_bootstrapper_quantile_raw():
+    rng = np.random.RandomState(5)
+    boot = BootStrapper(
+        Accuracy(num_classes=5), num_bootstraps=10, quantile=0.5, raw=True, sampling_strategy="multinomial"
+    )
+    boot.update(jnp.asarray(rng.randint(0, 5, (100,))), jnp.asarray(rng.randint(0, 5, (100,))))
+    out = boot.compute()
+    assert "quantile" in out and "raw" in out
+    assert out["raw"].shape == (10,)
+
+
+def test_bootstrapper_invalid():
+    with pytest.raises(ValueError, match="Expected base metric"):
+        BootStrapper("not-a-metric")
+    with pytest.raises(ValueError, match="sampling_strategy"):
+        BootStrapper(Accuracy(), sampling_strategy="bogus")
+
+
+def test_tracker_lifecycle():
+    tracker = MetricTracker(DummyMetricSum(), maximize=True)
+    with pytest.raises(ValueError, match="cannot be called before"):
+        tracker.update(jnp.asarray(1.0))
+    vals = [1.0, 5.0, 3.0]
+    for v in vals:
+        tracker.increment()
+        tracker.update(jnp.asarray(v))
+    np.testing.assert_allclose(np.asarray(tracker.compute_all()), vals)
+    best, step = tracker.best_metric(return_step=True)
+    assert best == 5.0 and step == 1
+    assert tracker.n_steps == 3
+
+
+def test_tracker_minimize():
+    tracker = MetricTracker(DummyMetricSum(), maximize=False)
+    for v in [3.0, 1.0, 2.0]:
+        tracker.increment()
+        tracker.update(jnp.asarray(v))
+    assert tracker.best_metric() == 1.0
