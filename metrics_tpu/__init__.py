@@ -41,6 +41,19 @@ from metrics_tpu.classification import (
     Specificity,
     StatScores,
 )
+from metrics_tpu.regression import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrcoef,
+    R2Score,
+    SpearmanCorrcoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+)
 from metrics_tpu.wrappers import BootStrapper, MetricTracker
 
 __all__ = [
@@ -60,6 +73,17 @@ __all__ = [
     "PrecisionRecallCurve",
     "ROC",
     "CohenKappa",
+    "CosineSimilarity",
+    "ExplainedVariance",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "PearsonCorrcoef",
+    "R2Score",
+    "SpearmanCorrcoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
     "CompositionalMetric",
     "ConfusionMatrix",
     "F1",

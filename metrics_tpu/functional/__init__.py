@@ -21,3 +21,18 @@ from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.calibration_error import calibration_error
 from metrics_tpu.functional.classification.hinge import hinge
 from metrics_tpu.functional.classification.kl_divergence import kl_divergence
+from metrics_tpu.functional.regression.cosine_similarity import cosine_similarity
+from metrics_tpu.functional.regression.explained_variance import explained_variance
+from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error
+from metrics_tpu.functional.regression.mean_absolute_percentage_error import (
+    mean_absolute_percentage_error,
+)
+from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error
+from metrics_tpu.functional.regression.mean_squared_log_error import mean_squared_log_error
+from metrics_tpu.functional.regression.pearson import pearson_corrcoef
+from metrics_tpu.functional.regression.r2 import r2_score
+from metrics_tpu.functional.regression.spearman import spearman_corrcoef
+from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error import (
+    symmetric_mean_absolute_percentage_error,
+)
+from metrics_tpu.functional.regression.tweedie_deviance import tweedie_deviance_score

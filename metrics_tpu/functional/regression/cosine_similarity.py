@@ -1,0 +1,33 @@
+"""Cosine similarity — analogue of reference
+``torchmetrics/functional/regression/cosine_similarity.py``."""
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _cosine_similarity_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    _check_same_shape(preds, target)
+    return preds.astype(jnp.float32), target.astype(jnp.float32)
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    dot_product = jnp.sum(preds * target, axis=-1)
+    preds_norm = jnp.linalg.norm(preds, axis=-1)
+    target_norm = jnp.linalg.norm(target, axis=-1)
+    similarity = dot_product / (preds_norm * target_norm)
+    if reduction == "sum":
+        return jnp.sum(similarity)
+    if reduction == "mean":
+        return jnp.mean(similarity)
+    if reduction in ("none", None):
+        return similarity
+    raise ValueError(f"Expected reduction to be one of ['sum', 'mean', 'none'] but got {reduction}")
+
+
+def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    r"""Cosine similarity between rows of preds and target."""
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

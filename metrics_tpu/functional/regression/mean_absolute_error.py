@@ -1,0 +1,23 @@
+"""MAE — analogue of reference
+``torchmetrics/functional/regression/mean_absolute_error.py``."""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_absolute_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    return jnp.sum(jnp.abs(preds - target)), preds.size
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, n_obs) -> Array:
+    return sum_abs_error / n_obs
+
+
+def mean_absolute_error(preds: Array, target: Array) -> Array:
+    """Mean absolute error."""
+    sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
+    return _mean_absolute_error_compute(sum_abs_error, n_obs)

@@ -1,0 +1,25 @@
+"""MSE / RMSE — analogue of reference
+``torchmetrics/functional/regression/mean_squared_error.py``."""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_squared_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    diff = preds - target
+    return jnp.sum(diff * diff), preds.size
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, n_obs, squared: bool = True) -> Array:
+    mse = sum_squared_error / n_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """Mean squared error (RMSE with ``squared=False``)."""
+    sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
+    return _mean_squared_error_compute(sum_squared_error, n_obs, squared)

@@ -1,0 +1,23 @@
+"""MSLE — analogue of reference
+``torchmetrics/functional/regression/mean_squared_log_error.py``."""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    return jnp.sum((jnp.log1p(preds) - jnp.log1p(target)) ** 2), preds.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs) -> Array:
+    return sum_squared_log_error / n_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """Mean squared log error."""
+    sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

@@ -1,0 +1,52 @@
+"""Pearson correlation — analogue of reference
+``torchmetrics/functional/regression/pearson.py:22-102`` (running moments)."""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    n_prior: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """One streaming-moments step; all data-dependence is arithmetic (jits)."""
+    _check_same_shape(preds, target)
+    preds = preds.squeeze()
+    target = target.squeeze()
+    if preds.ndim > 1 or target.ndim > 1:
+        raise ValueError("Expected both predictions and target to be 1 dimensional tensors.")
+
+    n_obs = preds.size
+    mx_new = (n_prior * mean_x + jnp.mean(preds) * n_obs) / (n_prior + n_obs)
+    my_new = (n_prior * mean_y + jnp.mean(target) * n_obs) / (n_prior + n_obs)
+    n_new = n_prior + n_obs
+    var_x = var_x + jnp.sum((preds - mx_new) * (preds - mean_x))
+    var_y = var_y + jnp.sum((target - my_new) * (target - mean_y))
+    corr_xy = corr_xy + jnp.sum((preds - mx_new) * (target - mean_y))
+    return mx_new, my_new, var_x, var_y, corr_xy, n_new
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    corrcoef = (corr_xy / jnp.sqrt(var_x * var_y)).squeeze()
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Pearson correlation coefficient."""
+    zero = jnp.zeros((), dtype=preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32)
+    _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, zero, zero, zero, zero, zero, zero
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)

@@ -1,0 +1,26 @@
+"""SMAPE — analogue of reference
+``torchmetrics/functional/regression/symmetric_mean_absolute_percentage_error.py``."""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def _symmetric_mean_absolute_percentage_error_update(
+    preds: Array, target: Array, epsilon: float = 1.17e-06
+) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    abs_per_error = 2 * jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), epsilon, None)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
+    """Symmetric mean absolute percentage error."""
+    sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

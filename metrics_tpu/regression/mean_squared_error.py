@@ -1,0 +1,37 @@
+"""MeanSquaredError module — analogue of reference
+``torchmetrics/regression/mean_squared_error.py`` (94 LoC)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.mean_squared_error import (
+    _mean_squared_error_compute,
+    _mean_squared_error_update,
+)
+
+
+class MeanSquaredError(Metric):
+    r"""MSE (or RMSE with ``squared=False``), accumulated over batches."""
+
+    def __init__(
+        self,
+        squared: bool = True,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        self.squared = squared
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> Array:
+        return _mean_squared_error_compute(self.sum_squared_error, self.total, self.squared)

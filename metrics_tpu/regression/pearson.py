@@ -1,0 +1,94 @@
+"""PearsonCorrcoef module — analogue of reference
+``torchmetrics/regression/pearson.py:56-144``.
+
+States are per-device running moments with ``dist_reduce_fx=None`` (gathered,
+not summed); the pairwise moment-merge formula (reference ``pearson.py:23-53``)
+is exposed both as the cross-device aggregation at compute time AND as this
+metric's ``merge_states`` — one algebra for DDP sync, ``forward`` and
+checkpoint-resume merging.
+"""
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.pearson import (
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+
+
+def _merge_two(
+    mx1: Array, my1: Array, vx1: Array, vy1: Array, cxy1: Array, n1: Array,
+    mx2: Array, my2: Array, vx2: Array, vy2: Array, cxy2: Array, n2: Array,
+) -> Tuple[Array, ...]:
+    """Pairwise merge of two running-moment states (reference pearson.py:23-53)."""
+    nb = n1 + n2
+    mean_x = (n1 * mx1 + n2 * mx2) / nb
+    mean_y = (n1 * my1 + n2 * my2) / nb
+    var_x = vx1 + vx2 + n1 * (mx1 - mean_x) ** 2 + n2 * (mx2 - mean_x) ** 2
+    var_y = vy1 + vy2 + n1 * (my1 - mean_y) ** 2 + n2 * (my2 - mean_y) ** 2
+    corr_xy = (
+        cxy1 + n1 * (mx1 - mean_x) * (my1 - mean_y)
+        + cxy2 + n2 * (mx2 - mean_x) * (my2 - mean_y)
+    )
+    return mean_x, mean_y, var_x, var_y, corr_xy, nb
+
+
+def _final_aggregation(
+    means_x: Array, means_y: Array, vars_x: Array, vars_y: Array, corrs_xy: Array, nbs: Array
+) -> Tuple[Array, Array, Array, Array]:
+    """Fold gathered per-device moment vectors into global statistics."""
+    mx1, my1, vx1, vy1, cxy1, n1 = means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+    for i in range(1, means_x.shape[0]):
+        mx1, my1, vx1, vy1, cxy1, n1 = _merge_two(
+            mx1, my1, vx1, vy1, cxy1, n1,
+            means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i],
+        )
+    return vx1, vy1, cxy1, n1
+
+
+class PearsonCorrcoef(Metric):
+    r"""Pearson correlation via mergeable running moments."""
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        self.add_state("mean_x", jnp.zeros(()), dist_reduce_fx=None)
+        self.add_state("mean_y", jnp.zeros(()), dist_reduce_fx=None)
+        self.add_state("var_x", jnp.zeros(()), dist_reduce_fx=None)
+        self.add_state("var_y", jnp.zeros(()), dist_reduce_fx=None)
+        self.add_state("corr_xy", jnp.zeros(()), dist_reduce_fx=None)
+        self.add_state("n_total", jnp.zeros(()), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds, target, self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+        )
+
+    def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+        if float(jnp.sum(jnp.atleast_1d(b["n_total"]))) == 0:
+            return dict(a)
+        if float(jnp.sum(jnp.atleast_1d(a["n_total"]))) == 0:
+            return dict(b)
+        mx, my, vx, vy, cxy, n = _merge_two(
+            a["mean_x"], a["mean_y"], a["var_x"], a["var_y"], a["corr_xy"], a["n_total"],
+            b["mean_x"], b["mean_y"], b["var_x"], b["var_y"], b["corr_xy"], b["n_total"],
+        )
+        return {"mean_x": mx, "mean_y": my, "var_x": vx, "var_y": vy, "corr_xy": cxy, "n_total": n}
+
+    def compute(self) -> Array:
+        if self.mean_x.ndim > 0 and self.mean_x.shape[0] > 1:
+            # gathered multi-device states: fold with the pairwise merge
+            var_x, var_y, corr_xy, n_total = _final_aggregation(
+                self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+            )
+        else:
+            var_x, var_y, corr_xy, n_total = self.var_x, self.var_y, self.corr_xy, self.n_total
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)

@@ -1,0 +1,35 @@
+"""SymmetricMeanAbsolutePercentageError module — analogue of reference
+``torchmetrics/regression/symmetric_mean_absolute_percentage_error.py`` (95 LoC)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error import (
+    _symmetric_mean_absolute_percentage_error_compute,
+    _symmetric_mean_absolute_percentage_error_update,
+)
+
+
+class SymmetricMeanAbsolutePercentageError(Metric):
+    r"""SMAPE accumulated over batches."""
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        self.add_state("sum_abs_per_error", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + sum_abs_per_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        return _symmetric_mean_absolute_percentage_error_compute(self.sum_abs_per_error, self.total)

@@ -1,0 +1,233 @@
+"""Regression metrics parity vs sklearn/scipy, mirroring the reference's
+`tests/regression/` strategy."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score as sk_explained_variance,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance as sk_tweedie,
+    r2_score as sk_r2,
+)
+
+from metrics_tpu import (
+    CosineSimilarity,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    PearsonCorrcoef,
+    R2Score,
+    SpearmanCorrcoef,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+)
+from metrics_tpu.functional import (
+    cosine_similarity,
+    explained_variance,
+    mean_absolute_error,
+    mean_absolute_percentage_error,
+    mean_squared_error,
+    mean_squared_log_error,
+    pearson_corrcoef,
+    r2_score,
+    spearman_corrcoef,
+    symmetric_mean_absolute_percentage_error,
+    tweedie_deviance_score,
+)
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+seed_all(42)
+
+_preds = np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) + 0.05
+_target = np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) + 0.05
+_preds_2d = np.random.rand(NUM_BATCHES, BATCH_SIZE, 3).astype(np.float32)
+_target_2d = np.random.rand(NUM_BATCHES, BATCH_SIZE, 3).astype(np.float32)
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn, sk_fn, metric_args",
+    [
+        (MeanSquaredError, mean_squared_error, sk_mse, {}),
+        (MeanSquaredError, mean_squared_error, lambda t, p: np.sqrt(sk_mse(t, p)), {"squared": False}),
+        (MeanAbsoluteError, mean_absolute_error, sk_mae, {}),
+        (MeanSquaredLogError, mean_squared_log_error, sk_msle, {}),
+        (MeanAbsolutePercentageError, mean_absolute_percentage_error, sk_mape, {}),
+    ],
+)
+class TestMeanErrors(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_class(self, ddp, metric_class, metric_fn, sk_fn, metric_args):
+        self.run_class_metric_test(
+            ddp=ddp, preds=_preds, target=_target, metric_class=metric_class,
+            sk_metric=lambda p, t: sk_fn(t, p), metric_args=metric_args,
+        )
+
+    def test_fn(self, metric_class, metric_fn, sk_fn, metric_args):
+        self.run_functional_metric_test(
+            _preds, _target, metric_functional=metric_fn,
+            sk_metric=lambda p, t: sk_fn(t, p), metric_args=metric_args,
+        )
+
+    def test_sharded(self, metric_class, metric_fn, sk_fn, metric_args):
+        self.run_sharded_metric_test(
+            preds=_preds, target=_target, metric_class=metric_class,
+            sk_metric=lambda p, t: sk_fn(t, p), metric_args=metric_args,
+        )
+
+
+def test_smape():
+    p, t = _preds[0], _target[0]
+    expected = np.mean(2 * np.abs(p - t) / (np.abs(p) + np.abs(t)))
+    res = symmetric_mean_absolute_percentage_error(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+    m = SymmetricMeanAbsolutePercentageError()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values", "variance_weighted"])
+def test_explained_variance(multioutput):
+    p = np.concatenate(list(_preds_2d))
+    t = np.concatenate(list(_target_2d))
+    res = explained_variance(jnp.asarray(p), jnp.asarray(t), multioutput=multioutput)
+    expected = sk_explained_variance(t, p, multioutput=multioutput)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-4)
+
+
+def test_explained_variance_class_accumulation():
+    m = ExplainedVariance()
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    expected = sk_explained_variance(np.concatenate(list(_target)), np.concatenate(list(_preds)))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-4)
+
+
+@pytest.mark.parametrize("multioutput", ["uniform_average", "raw_values", "variance_weighted"])
+def test_r2(multioutput):
+    p = np.concatenate(list(_preds_2d))
+    t = np.concatenate(list(_target_2d))
+    res = r2_score(jnp.asarray(p), jnp.asarray(t), multioutput=multioutput)
+    expected = sk_r2(t, p, multioutput=multioutput)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-4)
+
+
+def test_r2_adjusted():
+    p, t = _preds[0], _target[0]
+    res = r2_score(jnp.asarray(p), jnp.asarray(t), adjusted=5)
+    n = len(p)
+    base = sk_r2(t, p)
+    expected = 1 - (1 - base) * (n - 1) / (n - 5 - 1)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-4)
+
+
+def test_r2_class_multioutput():
+    m = R2Score(num_outputs=3)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds_2d[i]), jnp.asarray(_target_2d[i]))
+    expected = sk_r2(np.concatenate(list(_target_2d)), np.concatenate(list(_preds_2d)))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-4)
+
+
+def test_pearson_fn_and_class():
+    p = np.concatenate(list(_preds))
+    t = np.concatenate(list(_target))
+    expected = pearsonr(t, p)[0]
+    np.testing.assert_allclose(np.asarray(pearson_corrcoef(jnp.asarray(p), jnp.asarray(t))), expected, atol=1e-4)
+    m = PearsonCorrcoef()
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-4)
+
+
+def test_pearson_merge_states():
+    """The pairwise moment merge must equal single-stream accumulation."""
+    a, b = PearsonCorrcoef(), PearsonCorrcoef()
+    for i in range(0, NUM_BATCHES, 2):
+        a.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    for i in range(1, NUM_BATCHES, 2):
+        b.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    a.merge_state(b)
+    expected = pearsonr(np.concatenate(list(_target)), np.concatenate(list(_preds)))[0]
+    np.testing.assert_allclose(np.asarray(a.compute()), expected, atol=1e-4)
+
+
+def test_pearson_forward_batch_value():
+    m = PearsonCorrcoef()
+    v = m(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    expected = pearsonr(_target[0], _preds[0])[0]
+    np.testing.assert_allclose(np.asarray(v), expected, atol=1e-4)
+
+
+def test_spearman():
+    p = np.concatenate(list(_preds))
+    t = np.concatenate(list(_target))
+    expected = spearmanr(t, p)[0]
+    np.testing.assert_allclose(np.asarray(spearman_corrcoef(jnp.asarray(p), jnp.asarray(t))), expected, atol=1e-4)
+
+
+def test_spearman_with_ties():
+    rng = np.random.RandomState(0)
+    p = rng.randint(0, 5, 100).astype(np.float32)  # heavy ties
+    t = rng.randint(0, 5, 100).astype(np.float32)
+    expected = spearmanr(t, p)[0]
+    np.testing.assert_allclose(np.asarray(spearman_corrcoef(jnp.asarray(p), jnp.asarray(t))), expected, atol=1e-4)
+    m = SpearmanCorrcoef()
+    m.update(jnp.asarray(p[:50]), jnp.asarray(t[:50]))
+    m.update(jnp.asarray(p[50:]), jnp.asarray(t[50:]))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-4)
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean", "none"])
+def test_cosine_similarity(reduction):
+    p, t = _preds_2d[0], _target_2d[0]
+    dot = (p * t).sum(-1)
+    sim = dot / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))
+    expected = {"sum": sim.sum(), "mean": sim.mean(), "none": sim}[reduction]
+    res = cosine_similarity(jnp.asarray(p), jnp.asarray(t), reduction=reduction)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-5)
+    m = CosineSimilarity(reduction=reduction)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("power", [0, 1, 2, 3, 1.5, -1.5])
+def test_tweedie(power):
+    p = np.concatenate(list(_preds))
+    t = np.concatenate(list(_target))
+    res = tweedie_deviance_score(jnp.asarray(p), jnp.asarray(t), power=power)
+    expected = sk_tweedie(t, p, power=power)
+    np.testing.assert_allclose(np.asarray(res), expected, atol=1e-4, rtol=1e-4)
+    m = TweedieDevianceScore(power=power)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-4, rtol=1e-4)
+
+
+def test_tweedie_invalid():
+    with pytest.raises(ValueError, match="not defined for power"):
+        tweedie_deviance_score(jnp.asarray([1.0]), jnp.asarray([1.0]), power=0.5)
+    with pytest.raises(ValueError, match="strictly positive"):
+        tweedie_deviance_score(jnp.asarray([-1.0]), jnp.asarray([1.0]), power=1)
+
+
+def test_pearson_sharded():
+    """Pearson's None-reduce states gather correctly over the mesh and fold
+    through _final_aggregation."""
+    tester = MetricTester()
+    tester.atol = 1e-4
+    tester.run_sharded_metric_test(
+        preds=_preds,
+        target=_target,
+        metric_class=PearsonCorrcoef,
+        sk_metric=lambda p, t: pearsonr(t.ravel(), p.ravel())[0],
+        metric_args={},
+    )
