@@ -54,6 +54,15 @@ from metrics_tpu.regression import (
     SymmetricMeanAbsolutePercentageError,
     TweedieDevianceScore,
 )
+from metrics_tpu.retrieval import (
+    RetrievalFallOut,
+    RetrievalMAP,
+    RetrievalMetric,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
 from metrics_tpu.wrappers import BootStrapper, MetricTracker
 
 __all__ = [
@@ -81,6 +90,13 @@ __all__ = [
     "MeanSquaredLogError",
     "PearsonCorrcoef",
     "R2Score",
+    "RetrievalFallOut",
+    "RetrievalMAP",
+    "RetrievalMetric",
+    "RetrievalMRR",
+    "RetrievalNormalizedDCG",
+    "RetrievalPrecision",
+    "RetrievalRecall",
     "SpearmanCorrcoef",
     "SymmetricMeanAbsolutePercentageError",
     "TweedieDevianceScore",

@@ -36,3 +36,9 @@ from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error 
     symmetric_mean_absolute_percentage_error,
 )
 from metrics_tpu.functional.regression.tweedie_deviance import tweedie_deviance_score
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
+from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
+from metrics_tpu.functional.retrieval.precision import retrieval_precision
+from metrics_tpu.functional.retrieval.recall import retrieval_recall
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank

@@ -1,0 +1,18 @@
+"""Single-query retrieval AP — analogue of reference
+``torchmetrics/functional/retrieval/average_precision.py``."""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def retrieval_average_precision(preds: Array, target: Array) -> Array:
+    """AP of one query's predictions; 0 if no positive target."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not jnp.sum(target):
+        return jnp.asarray(0.0)
+    target = target[jnp.argsort(-preds)]
+    rel = target > 0
+    positions = jnp.arange(1, target.shape[0] + 1, dtype=jnp.float32)
+    cum_rel = jnp.cumsum(rel)
+    return jnp.sum(jnp.where(rel, cum_rel / positions, 0.0)) / jnp.sum(rel)

@@ -1,0 +1,21 @@
+"""Single-query precision@k — analogue of reference
+``torchmetrics/functional/retrieval/precision.py``."""
+from typing import Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def retrieval_precision(preds: Array, target: Array, k: Optional[int] = None) -> Array:
+    """Fraction of relevant documents among the top ``k`` retrieved."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if k is None:
+        k = preds.shape[-1]
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+    if not jnp.sum(target):
+        return jnp.asarray(0.0)
+    relevant = jnp.sum(target[jnp.argsort(-preds)][:k]).astype(jnp.float32)
+    return relevant / k

@@ -1,0 +1,16 @@
+"""Single-query reciprocal rank — analogue of reference
+``torchmetrics/functional/retrieval/reciprocal_rank.py``."""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
+    """1 / rank of the first relevant document; 0 if none."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    if not jnp.sum(target):
+        return jnp.asarray(0.0)
+    target = target[jnp.argsort(-preds)]
+    first = jnp.argmax(target > 0)
+    return 1.0 / (first + 1.0)

@@ -1,0 +1,76 @@
+"""Sorted segment ops for ragged query groups.
+
+TPU-native replacement for the reference's per-group python loop
+(``torchmetrics/retrieval/retrieval_metric.py:110-139`` +
+``utilities/data.py:203-227``): rows are lex-sorted by (query id, -score),
+after which every per-query retrieval statistic is a segment reduction —
+one fused XLA program over all queries instead of a python loop.
+"""
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+class GroupedByQuery(NamedTuple):
+    """Flat rows sorted by (query, score desc) with segment bookkeeping."""
+
+    preds: Array       # [N] scores, sorted
+    target: Array      # [N] relevance, aligned
+    gid: Array         # [N] 0-based dense group id, non-decreasing
+    rank: Array        # [N] 1-based rank within the group (by score desc)
+    num_groups: int    # number of distinct queries (static for jit callers)
+    group_sizes: Array  # [G]
+
+
+def group_by_query(indexes: Array, preds: Array, target: Array, num_groups: Optional[int] = None) -> GroupedByQuery:
+    """Sort rows by (query id asc, score desc) and build segment metadata.
+
+    ``num_groups`` may be passed for a jit-static group count; otherwise it is
+    read from the data (eager only).
+    """
+    order = jnp.lexsort((-preds, indexes))
+    idx_s = indexes[order]
+    preds_s = preds[order]
+    target_s = target[order]
+
+    new_group = jnp.concatenate([jnp.asarray([True]), idx_s[1:] != idx_s[:-1]])
+    gid = jnp.cumsum(new_group) - 1
+    if num_groups is None:
+        num_groups = int(gid[-1]) + 1 if idx_s.size else 0
+
+    positions = jnp.arange(idx_s.shape[0])
+    group_start = jax.ops.segment_min(positions, gid, num_segments=num_groups)
+    rank = positions - group_start[gid] + 1
+    group_sizes = jax.ops.segment_sum(jnp.ones_like(gid), gid, num_segments=num_groups)
+    return GroupedByQuery(preds_s, target_s, gid, rank, num_groups, group_sizes)
+
+
+def segment_sum(values: Array, g: GroupedByQuery) -> Array:
+    return jax.ops.segment_sum(values, g.gid, num_segments=g.num_groups)
+
+
+def segment_min(values: Array, g: GroupedByQuery) -> Array:
+    return jax.ops.segment_min(values, g.gid, num_segments=g.num_groups)
+
+
+def segment_cumsum(values: Array, g: GroupedByQuery) -> Array:
+    """Within-group cumulative sum (inclusive) for sorted segments."""
+    prefix = jnp.cumsum(values)
+    positions = jnp.arange(values.shape[0])
+    start = jax.ops.segment_min(positions, g.gid, num_segments=g.num_groups)
+    # prefix value just before each group's first row
+    before = jnp.where(start > 0, prefix[jnp.maximum(start - 1, 0)], 0)
+    return prefix - before[g.gid]
+
+
+def relevance_sorted(g: GroupedByQuery):
+    """(target, rank) with rows re-sorted by relevance desc within each group
+    (gid is unchanged by a within-group permutation) — the 'ideal' ordering
+    used for IDCG."""
+    order = jnp.lexsort((-g.target, g.gid))
+    positions = jnp.arange(g.gid.shape[0])
+    start = jax.ops.segment_min(positions, g.gid, num_segments=g.num_groups)
+    rank_sorted = positions - start[g.gid] + 1
+    return g.target[order], rank_sorted

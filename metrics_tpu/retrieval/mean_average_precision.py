@@ -1,0 +1,18 @@
+"""RetrievalMAP — analogue of reference
+``torchmetrics/retrieval/mean_average_precision.py``."""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.segment import GroupedByQuery, segment_cumsum, segment_sum
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Mean average precision over queries (vectorized over all groups)."""
+
+    def _segment_metric(self, g: GroupedByQuery) -> Array:
+        rel = (g.target > 0).astype(jnp.float32)
+        cum_rel = segment_cumsum(rel, g)
+        contrib = jnp.where(rel > 0, cum_rel / g.rank, 0.0)
+        npos = segment_sum(rel, g)
+        return segment_sum(contrib, g) / jnp.maximum(npos, 1.0)

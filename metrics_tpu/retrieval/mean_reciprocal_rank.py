@@ -1,0 +1,17 @@
+"""RetrievalMRR — analogue of reference
+``torchmetrics/retrieval/mean_reciprocal_rank.py``."""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.segment import GroupedByQuery, segment_min
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+_BIG = jnp.iinfo(jnp.int32).max
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Mean reciprocal rank of the first relevant document per query."""
+
+    def _segment_metric(self, g: GroupedByQuery) -> Array:
+        first_rel_rank = segment_min(jnp.where(g.target > 0, g.rank, _BIG), g)
+        return jnp.where(first_rel_rank == _BIG, 0.0, 1.0 / first_rel_rank)

@@ -1,0 +1,43 @@
+"""RetrievalFallOut — analogue of reference
+``torchmetrics/retrieval/retrieval_fallout.py`` (the empty-query policy is
+keyed on queries with no NEGATIVE targets, inverted vs the other metrics)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.segment import GroupedByQuery, segment_sum
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalFallOut(RetrievalMetric):
+    """Mean fall-out@k: non-relevant retrieved / all non-relevant."""
+
+    higher_is_better = False
+    empty_on_negatives = True
+
+    def __init__(
+        self,
+        empty_target_action: str = "pos",
+        k: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            empty_target_action=empty_target_action,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _segment_metric(self, g: GroupedByQuery) -> Array:
+        nonrel = (g.target == 0).astype(jnp.float32)
+        in_topk = nonrel if self.k is None else nonrel * (g.rank <= self.k)
+        nneg = segment_sum(nonrel, g)
+        return segment_sum(in_topk, g) / jnp.maximum(nneg, 1.0)

@@ -1,0 +1,96 @@
+"""RetrievalMetric base — stateful accumulation grouped by query id.
+
+Behavioral analogue of the reference's
+``torchmetrics/retrieval/retrieval_metric.py:27-146``, with the TPU re-design
+promised in SURVEY §7: instead of a python loop over ragged query groups
+(reference ``retrieval_metric.py:110-139``), ``compute`` lex-sorts all rows by
+(query, score desc) once and evaluates EVERY query simultaneously with segment
+reductions (``metrics_tpu/ops/segment.py``) — one fused XLA program regardless
+of the number of queries. Subclasses implement ``_segment_metric`` (all-groups
+vectorized scores) and inherit the empty-target policy handling; the reference
+API's per-query ``_metric`` remains available through the functional layer.
+"""
+from abc import ABC, abstractmethod
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.ops.segment import GroupedByQuery, group_by_query, segment_sum
+from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.data import dim_zero_cat
+
+
+class RetrievalMetric(Metric, ABC):
+    """Accumulates (indexes, preds, target) rows; computes the mean of a
+    per-query metric over all queries."""
+
+    higher_is_better = True
+    allow_non_binary_target = False
+    # which rows make a query "empty" for the policy: positives (default) or
+    # negatives (FallOut inverts this, reference fall_out.py compute)
+    empty_on_negatives = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
+        self.empty_target_action = empty_target_action
+
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:  # type: ignore[override]
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        indexes, preds, target = _check_retrieval_inputs(
+            indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target
+        )
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        indexes = dim_zero_cat(self.indexes)
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        if preds.size == 0:
+            return jnp.asarray(0.0)
+
+        g = group_by_query(indexes, preds, target)
+        scores = self._segment_metric(g)  # [G]
+
+        if self.empty_on_negatives:
+            empty = segment_sum((1 - (g.target > 0)).astype(jnp.int32), g) == 0
+        else:
+            empty = segment_sum((g.target > 0).astype(jnp.int32), g) == 0
+
+        if self.empty_target_action == "error":
+            if bool(jnp.any(empty)):
+                raise ValueError("`compute` method was provided with a query with no positive target.")
+            return jnp.mean(scores)
+        if self.empty_target_action == "skip":
+            valid = ~empty
+            n_valid = jnp.sum(valid)
+            return jnp.where(n_valid == 0, 0.0, jnp.sum(jnp.where(valid, scores, 0.0)) / jnp.maximum(n_valid, 1))
+        fill = 1.0 if self.empty_target_action == "pos" else 0.0
+        return jnp.mean(jnp.where(empty, fill, scores))
+
+    @abstractmethod
+    def _segment_metric(self, g: GroupedByQuery) -> Array:
+        """Vectorized per-query scores ``[num_groups]`` over sorted segments."""

@@ -1,0 +1,47 @@
+"""RetrievalNormalizedDCG — analogue of reference
+``torchmetrics/retrieval/retrieval_ndcg.py`` (non-binary targets allowed)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.segment import GroupedByQuery, relevance_sorted, segment_sum
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalNormalizedDCG(RetrievalMetric):
+    """Mean nDCG@k over queries; linear gain, log2 discount."""
+
+    allow_non_binary_target = True
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        k: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            empty_target_action=empty_target_action,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _segment_metric(self, g: GroupedByQuery) -> Array:
+        in_topk = jnp.ones_like(g.rank, dtype=bool) if self.k is None else g.rank <= self.k
+        discount = jnp.log2(g.rank + 1.0)
+        dcg = segment_sum(jnp.where(in_topk, g.target / discount, 0.0), g)
+
+        ideal_target, ideal_rank = relevance_sorted(g)
+        ideal_in_topk = jnp.ones_like(ideal_rank, dtype=bool) if self.k is None else ideal_rank <= self.k
+        ideal_discount = jnp.log2(ideal_rank + 1.0)
+        idcg = segment_sum(jnp.where(ideal_in_topk, ideal_target / ideal_discount, 0.0), g)
+
+        return jnp.where(idcg == 0, 0.0, dcg / jnp.where(idcg == 0, 1.0, idcg))

@@ -1,0 +1,41 @@
+"""RetrievalPrecision — analogue of reference
+``torchmetrics/retrieval/retrieval_precision.py``."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.segment import GroupedByQuery, segment_sum
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Mean precision@k over queries (k=None → full group size)."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        k: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            empty_target_action=empty_target_action,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _segment_metric(self, g: GroupedByQuery) -> Array:
+        rel = (g.target > 0).astype(jnp.float32)
+        if self.k is None:
+            rel_topk = segment_sum(rel, g)
+            return rel_topk / g.group_sizes.astype(jnp.float32)
+        rel_topk = segment_sum(rel * (g.rank <= self.k), g)
+        return rel_topk / float(self.k)

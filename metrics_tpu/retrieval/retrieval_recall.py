@@ -1,0 +1,39 @@
+"""RetrievalRecall — analogue of reference
+``torchmetrics/retrieval/retrieval_recall.py``."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.ops.segment import GroupedByQuery, segment_sum
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Mean recall@k over queries."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        k: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(
+            empty_target_action=empty_target_action,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _segment_metric(self, g: GroupedByQuery) -> Array:
+        rel = (g.target > 0).astype(jnp.float32)
+        in_topk = rel if self.k is None else rel * (g.rank <= self.k)
+        npos = segment_sum(rel, g)
+        return segment_sum(in_topk, g) / jnp.maximum(npos, 1.0)

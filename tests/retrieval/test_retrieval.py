@@ -1,0 +1,227 @@
+"""Retrieval metrics: vectorized segment compute vs per-query numpy references
+(sklearn average_precision / ndcg + hand-rolled), mirroring the reference's
+`tests/retrieval/` strategy."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_ap, ndcg_score as sk_ndcg
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from tests.helpers import seed_all
+
+seed_all(42)
+
+N_QUERIES = 20
+ROWS = 400
+
+
+def _make_inputs(binary_target=True, guarantee_pos=False):
+    rng = np.random.RandomState(7)
+    indexes = rng.randint(0, N_QUERIES, ROWS)
+    preds = rng.rand(ROWS).astype(np.float32)
+    if binary_target:
+        target = rng.randint(0, 2, ROWS)
+    else:
+        target = rng.randint(0, 5, ROWS)
+    if guarantee_pos:
+        for q in range(N_QUERIES):
+            rows = np.nonzero(indexes == q)[0]
+            if len(rows) and target[rows].sum() == 0:
+                target[rows[0]] = 1
+    return indexes, preds, target
+
+
+def _per_query_mean(indexes, preds, target, fn, empty="neg", empty_on_neg=False):
+    scores = []
+    for q in np.unique(indexes):
+        rows = indexes == q
+        t, p = target[rows], preds[rows]
+        empty_cond = (1 - (t > 0)).sum() == 0 if empty_on_neg else (t > 0).sum() == 0
+        if empty_cond:
+            if empty == "neg":
+                scores.append(0.0)
+            elif empty == "pos":
+                scores.append(1.0)
+            elif empty == "skip":
+                continue
+        else:
+            scores.append(fn(p, t))
+    return np.mean(scores) if scores else 0.0
+
+
+def _np_ap(p, t):
+    order = np.argsort(-p)
+    t = t[order] > 0
+    cum = np.cumsum(t)
+    pos = np.arange(1, len(t) + 1)
+    return (cum[t] / pos[t]).mean()
+
+
+def _np_rr(p, t):
+    order = np.argsort(-p)
+    t = t[order] > 0
+    return 1.0 / (np.argmax(t) + 1)
+
+
+def _np_prec(p, t, k):
+    kk = len(p) if k is None else k
+    order = np.argsort(-p)
+    return (t[order] > 0)[:kk].sum() / kk
+
+
+def _np_rec(p, t, k):
+    kk = len(p) if k is None else k
+    order = np.argsort(-p)
+    return (t[order] > 0)[:kk].sum() / (t > 0).sum()
+
+
+def _np_fallout(p, t, k):
+    kk = len(p) if k is None else k
+    order = np.argsort(-p)
+    neg = (t[order] == 0)[:kk].sum()
+    return neg / (t == 0).sum()
+
+
+def _np_ndcg(p, t, k):
+    kk = len(p) if k is None else k
+    order = np.argsort(-p)
+    st = t[order][:kk]
+    it = np.sort(t)[::-1][:kk]
+    dcg = (st / np.log2(np.arange(len(st)) + 2)).sum()
+    idcg = (it / np.log2(np.arange(len(it)) + 2)).sum()
+    return 0.0 if idcg == 0 else dcg / idcg
+
+
+@pytest.mark.parametrize("empty_action", ["neg", "pos", "skip"])
+def test_retrieval_map(empty_action):
+    indexes, preds, target = _make_inputs()
+    m = RetrievalMAP(empty_target_action=empty_action)
+    # feed in two batches
+    m.update(jnp.asarray(preds[:200]), jnp.asarray(target[:200]), jnp.asarray(indexes[:200]))
+    m.update(jnp.asarray(preds[200:]), jnp.asarray(target[200:]), jnp.asarray(indexes[200:]))
+    expected = _per_query_mean(indexes, preds, target, _np_ap, empty=empty_action)
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+def test_retrieval_mrr():
+    indexes, preds, target = _make_inputs()
+    m = RetrievalMRR()
+    m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    expected = _per_query_mean(indexes, preds, target, _np_rr)
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("k", [None, 1, 3, 10])
+def test_retrieval_precision_recall(k):
+    indexes, preds, target = _make_inputs()
+    mp = RetrievalPrecision(k=k)
+    mr = RetrievalRecall(k=k)
+    mp.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    mr.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    exp_p = _per_query_mean(indexes, preds, target, lambda p, t: _np_prec(p, t, k))
+    exp_r = _per_query_mean(indexes, preds, target, lambda p, t: _np_rec(p, t, k))
+    np.testing.assert_allclose(np.asarray(mp.compute()), exp_p, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mr.compute()), exp_r, atol=1e-5)
+
+
+@pytest.mark.parametrize("k", [None, 3])
+def test_retrieval_fallout(k):
+    indexes, preds, target = _make_inputs()
+    m = RetrievalFallOut(k=k)
+    m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    expected = _per_query_mean(
+        indexes, preds, target, lambda p, t: _np_fallout(p, t, k), empty="pos", empty_on_neg=True
+    )
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("k", [None, 5])
+def test_retrieval_ndcg(k):
+    indexes, preds, target = _make_inputs(binary_target=False)
+    m = RetrievalNormalizedDCG(k=k)
+    m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    expected = _per_query_mean(indexes, preds, target, lambda p, t: _np_ndcg(p, t, k))
+    np.testing.assert_allclose(np.asarray(m.compute()), expected, atol=1e-5)
+
+
+def test_retrieval_empty_error():
+    indexes = np.asarray([0, 0, 1, 1])
+    preds = np.asarray([0.1, 0.2, 0.3, 0.4], dtype=np.float32)
+    target = np.asarray([1, 0, 0, 0])  # query 1 has no positive
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    with pytest.raises(ValueError, match="no positive target"):
+        m.compute()
+
+
+def test_functional_single_query_parity_vs_sklearn():
+    rng = np.random.RandomState(3)
+    p = rng.rand(50).astype(np.float32)
+    t = rng.randint(0, 2, 50)
+    np.testing.assert_allclose(
+        np.asarray(retrieval_average_precision(jnp.asarray(p), jnp.asarray(t))), sk_ap(t, p), atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t))),
+        sk_ndcg(t[None], p[None]),
+        atol=1e-5,
+    )
+    # doctest values from the reference
+    np.testing.assert_allclose(
+        np.asarray(retrieval_reciprocal_rank(jnp.asarray([0.2, 0.3, 0.5]), jnp.asarray([False, True, False]))),
+        0.5,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(retrieval_precision(jnp.asarray([0.2, 0.3, 0.5]), jnp.asarray([True, False, True]), k=2)),
+        0.5,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(retrieval_recall(jnp.asarray([0.2, 0.3, 0.5]), jnp.asarray([True, False, True]), k=2)),
+        0.5,
+        atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(retrieval_fall_out(jnp.asarray([0.2, 0.3, 0.5]), jnp.asarray([True, False, True]), k=2)),
+        1.0,
+        atol=1e-6,
+    )
+
+
+def test_retrieval_invalid_inputs():
+    m = RetrievalMAP()
+    with pytest.raises(ValueError, match="same shape"):
+        m.update(jnp.asarray([0.1, 0.2]), jnp.asarray([1]), jnp.asarray([0, 0]))
+    with pytest.raises(ValueError, match="long integers"):
+        m.update(jnp.asarray([0.1]), jnp.asarray([1]), jnp.asarray([0.5]))
+    with pytest.raises(ValueError, match="binary"):
+        m.update(jnp.asarray([0.1]), jnp.asarray([3]), jnp.asarray([0]))
+    with pytest.raises(ValueError, match="wrong value"):
+        RetrievalMAP(empty_target_action="bogus")
+
+
+def test_retrieval_merge_across_instances():
+    indexes, preds, target = _make_inputs()
+    full = RetrievalMAP()
+    full.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(indexes))
+    a, b = RetrievalMAP(), RetrievalMAP()
+    a.update(jnp.asarray(preds[:150]), jnp.asarray(target[:150]), jnp.asarray(indexes[:150]))
+    b.update(jnp.asarray(preds[150:]), jnp.asarray(target[150:]), jnp.asarray(indexes[150:]))
+    a.merge_state(b)
+    np.testing.assert_allclose(np.asarray(a.compute()), np.asarray(full.compute()), atol=1e-6)
