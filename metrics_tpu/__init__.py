@@ -54,6 +54,7 @@ from metrics_tpu.regression import (
     SymmetricMeanAbsolutePercentageError,
     TweedieDevianceScore,
 )
+from metrics_tpu.image import FID, IS, KID, LPIPS, PSNR, SSIM
 from metrics_tpu.retrieval import (
     RetrievalFallOut,
     RetrievalMAP,
@@ -102,6 +103,12 @@ __all__ = [
     "TweedieDevianceScore",
     "CompositionalMetric",
     "ConfusionMatrix",
+    "FID",
+    "IS",
+    "KID",
+    "LPIPS",
+    "PSNR",
+    "SSIM",
     "F1",
     "FBeta",
     "HammingDistance",

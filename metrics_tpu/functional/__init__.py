@@ -36,6 +36,9 @@ from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error 
     symmetric_mean_absolute_percentage_error,
 )
 from metrics_tpu.functional.regression.tweedie_deviance import tweedie_deviance_score
+from metrics_tpu.functional.image.gradients import image_gradients
+from metrics_tpu.functional.image.psnr import psnr
+from metrics_tpu.functional.image.ssim import ssim
 from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
 from metrics_tpu.functional.retrieval.fall_out import retrieval_fall_out
 from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg

@@ -1,0 +1,3 @@
+from metrics_tpu.functional.image.gradients import image_gradients  # noqa: F401
+from metrics_tpu.functional.image.psnr import psnr  # noqa: F401
+from metrics_tpu.functional.image.ssim import ssim  # noqa: F401

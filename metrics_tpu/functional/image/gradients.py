@@ -1,0 +1,28 @@
+"""Image gradients — analogue of reference
+``torchmetrics/functional/image/gradients.py`` (82 LoC)."""
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _image_gradients_validate(img: Array) -> None:
+    if img.ndim != 4:
+        raise RuntimeError(f"The size of the image tensor {img.shape} is not supported. Expected BxCxHxW.")
+
+
+def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Forward finite differences along H and W, zero-padded at the far edge
+    (reference ``gradients.py:35-57``)."""
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Per-pixel (dy, dx) gradients of a BxCxHxW image batch
+    (reference ``gradients.py:60-82``)."""
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

@@ -5,7 +5,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utils.checks import _check_retrieval_k, _check_retrieval_functional_inputs
 
 
 def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
@@ -13,8 +13,7 @@ def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> 
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if k is None:
         k = preds.shape[-1]
-    if not (isinstance(k, int) and k > 0):
-        raise ValueError("`k` has to be a positive integer or None")
+    _check_retrieval_k(k)
     target = 1 - target
     if not jnp.sum(target):
         return jnp.asarray(0.0)

@@ -5,7 +5,7 @@ from typing import Optional
 import jax.numpy as jnp
 from jax import Array
 
-from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utils.checks import _check_retrieval_k, _check_retrieval_functional_inputs
 
 
 def _dcg(target: Array) -> Array:
@@ -17,8 +17,7 @@ def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = Non
     """nDCG with linear gain (reference semantics); non-binary targets allowed."""
     preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
     k = preds.shape[-1] if k is None else k
-    if not (isinstance(k, int) and k > 0):
-        raise ValueError("`k` has to be a positive integer or None")
+    _check_retrieval_k(k)
     sorted_target = target[jnp.argsort(-preds)][:k]
     ideal_target = jnp.sort(target)[::-1][:k]
     ideal_dcg = _dcg(ideal_target)

@@ -1,0 +1,131 @@
+"""Fréchet Inception Distance — analogue of reference
+``torchmetrics/image/fid.py`` (284 LoC), fully on-device.
+
+Key redesigns vs the reference:
+
+- **Feature extractor is an XLA graph** (`InceptionFeatureExtractor`), not a
+  wrapped third-party torch module (reference ``fid.py:26-55``).
+- **No host escape:** the Fréchet trace term runs on-device via an eigh-based
+  ``trace(sqrtm(S1 S2))`` (see :mod:`metrics_tpu.ops.linalg`) instead of
+  shipping a 2048x2048 matrix to CPU scipy (reference ``fid.py:58-93``).
+- **Constant-memory option:** ``streaming=True`` accumulates the Gaussian
+  sufficient statistics (feature sum, outer-product sum, count) as psum-able
+  sum states instead of buffering every feature row (the reference warns
+  about its O(samples x 2048) buffer, ``fid.py:224-228``). The default
+  mirrors the reference's buffered design, which supports uneven gathers.
+"""
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.models.inception import InceptionFeatureExtractor
+from metrics_tpu.ops.linalg import trace_sqrtm_product
+from metrics_tpu.utils.data import dim_zero_cat
+
+_HIGH = jnp.float64  # silently float32 unless jax x64 is enabled
+
+
+def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+    r"""Fréchet distance between N(mu1, sigma1) and N(mu2, sigma2):
+    ``||mu1-mu2||^2 + Tr(sigma1 + sigma2 - 2 sqrt(sigma1 sigma2))``
+    (reference ``fid.py:96-123``)."""
+    diff = mu1 - mu2
+    tr_covmean = trace_sqrtm_product(sigma1, sigma2)
+    return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
+
+
+def _mean_cov(features: Array) -> Tuple[Array, Array]:
+    n = features.shape[0]
+    mean = features.mean(axis=0)
+    diff = features - mean
+    cov = diff.T @ diff / (n - 1)
+    return mean, cov
+
+
+def _stats_to_mean_cov(s: Array, ss: Array, n: Array) -> Tuple[Array, Array]:
+    mean = s / n
+    cov = (ss - n * jnp.outer(mean, mean)) / (n - 1)
+    return mean, cov
+
+
+class FID(Metric):
+    r"""Fréchet Inception Distance between real and generated images.
+
+    Args:
+        feature: Inception tap (64 | 192 | 768 | 2048) for the default
+            extractor, or any callable ``imgs -> [N, D] features``.
+        weights: pretrained torchvision inception_v3 state dict / checkpoint
+            path for the default extractor (random init otherwise).
+        streaming: accumulate (sum, outer-product sum, count) sufficient
+            statistics instead of buffering features — constant memory,
+            exactly equivalent mean/cov, recommended on TPU.
+        feature_dim: feature dimensionality, required for ``streaming=True``
+            with a callable ``feature`` (inferred from integer taps).
+    """
+
+    def __init__(
+        self,
+        feature: Union[int, str, Callable] = 2048,
+        weights: Optional[Any] = None,
+        streaming: bool = False,
+        feature_dim: Optional[int] = None,
+        compute_on_step: bool = False,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        if callable(feature):
+            self.inception = feature
+            feat_dim = feature_dim
+        elif isinstance(feature, (int, str)) and str(feature) in ("64", "192", "768", "2048"):
+            self.inception = InceptionFeatureExtractor(feature=feature, weights=weights)
+            feat_dim = int(feature)
+        else:
+            raise ValueError(
+                f"Integer input to argument `feature` must be one of (64, 192, 768, 2048), got {feature}"
+            )
+        self.streaming = streaming
+        if streaming:
+            if feat_dim is None:
+                raise ValueError(
+                    "`streaming=True` requires a known feature dim: pass an integer"
+                    " `feature` tap or `feature_dim=` alongside a callable."
+                )
+            for side in ("real", "fake"):
+                self.add_state(f"{side}_sum", jnp.zeros((feat_dim,), dtype=_HIGH), dist_reduce_fx="sum")
+                self.add_state(
+                    f"{side}_outer", jnp.zeros((feat_dim, feat_dim), dtype=_HIGH), dist_reduce_fx="sum"
+                )
+                self.add_state(f"{side}_n", jnp.zeros((), dtype=_HIGH), dist_reduce_fx="sum")
+        else:
+            self.add_state("real_features", [], dist_reduce_fx=None)
+            self.add_state("fake_features", [], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:  # type: ignore[override]
+        features = self.inception(imgs)
+        if self.streaming:
+            f = features.astype(_HIGH)
+            side = "real" if real else "fake"
+            setattr(self, f"{side}_sum", getattr(self, f"{side}_sum") + f.sum(axis=0))
+            setattr(self, f"{side}_outer", getattr(self, f"{side}_outer") + f.T @ f)
+            setattr(self, f"{side}_n", getattr(self, f"{side}_n") + f.shape[0])
+        elif real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Array:
+        """FID over all accumulated features (reference ``fid.py:265-284``);
+        moments in the highest available precision."""
+        if self.streaming:
+            mean1, cov1 = _stats_to_mean_cov(self.real_sum, self.real_outer, self.real_n)
+            mean2, cov2 = _stats_to_mean_cov(self.fake_sum, self.fake_outer, self.fake_n)
+        else:
+            real = dim_zero_cat(self.real_features).astype(_HIGH)
+            fake = dim_zero_cat(self.fake_features).astype(_HIGH)
+            mean1, cov1 = _mean_cov(real)
+            mean2, cov2 = _mean_cov(fake)
+        return _compute_fid(mean1, cov1, mean2, cov2).astype(jnp.float32)

@@ -1,0 +1,67 @@
+"""LPIPS module — analogue of reference
+``torchmetrics/image/lpip_similarity.py`` (159 LoC), with the perceptual
+network as an in-framework XLA graph (:mod:`metrics_tpu.models.lpips_net`)
+instead of a wrapped third-party torch package."""
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.models.lpips_net import LPIPSNetwork
+
+
+def _valid_img(img: Array) -> bool:
+    """[N, 3, H, W] with values in [-1, 1] (reference ``lpip_similarity.py:36-38``)."""
+    shape_ok = img.ndim == 4 and img.shape[1] == 3
+    if not shape_ok:
+        return False
+    return bool(np.asarray(img).min() >= -1.0) and bool(np.asarray(img).max() <= 1.0)
+
+
+class LPIPS(Metric):
+    r"""Learned Perceptual Image Patch Similarity, accumulated over batches.
+
+    Args:
+        net_type: 'alex' | 'vgg' feature tower.
+        reduction: 'mean' | 'sum' over all scored pairs.
+        net: optional custom callable ``(img0, img1) -> [N] distances``
+            (replaces the built-in tower, e.g. one with loaded weights).
+    """
+
+    def __init__(
+        self,
+        net_type: str = "alex",
+        reduction: str = "mean",
+        net: Optional[Union[LPIPSNetwork, Callable]] = None,
+        weights: Optional[Tuple[Any, Any]] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        self.net = net if net is not None else LPIPSNetwork(net=net_type, weights=weights)
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.reduction = reduction
+        self.add_state("sum_scores", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:  # type: ignore[override]
+        if not (_valid_img(img1) and _valid_img(img2)):
+            raise ValueError(
+                "Expected both input arguments to be normalized tensors (all values in range [-1,1])"
+                f" and to have shape [N, 3, H, W] but `img1` have shape {img1.shape}"
+                f" and `img2` have shape {img2.shape}"
+            )
+        loss = self.net(img1, img2)
+        self.sum_scores = self.sum_scores + loss.sum()
+        self.total = self.total + img1.shape[0]
+
+    def compute(self) -> Array:
+        if self.reduction == "mean":
+            return self.sum_scores / self.total
+        return self.sum_scores

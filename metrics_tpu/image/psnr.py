@@ -1,0 +1,85 @@
+"""PSNR module — analogue of reference ``torchmetrics/image/psnr.py`` (147 LoC).
+
+State pattern mirrors the reference: scalar sum states when ``dim`` is None
+(psum-able, constant memory); cat-list states of per-slice statistics when
+``dim`` is set; min/max-reduced range trackers when ``data_range`` must be
+inferred (reference ``psnr.py:92-112``).
+"""
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.image.psnr import _psnr_compute, _psnr_update
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+class PSNR(Metric):
+    r"""Peak signal-to-noise ratio, accumulated over batches.
+
+    Args:
+        data_range: value range of the input; tracked from data when ``None``
+            (disallowed when ``dim`` is set).
+        base: logarithm base.
+        reduction: 'elementwise_mean' | 'sum' | 'none' over per-``dim`` scores.
+        dim: dimensions to reduce over; ``None`` = all (scalar states).
+    """
+
+    def __init__(
+        self,
+        data_range: Optional[float] = None,
+        base: float = 10.0,
+        reduction: str = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        if dim is None and reduction != "elementwise_mean":
+            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+        if dim is None:
+            self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+        else:
+            self.add_state("sum_squared_error", [], dist_reduce_fx="cat")
+            self.add_state("total", [], dist_reduce_fx="cat")
+
+        if data_range is None:
+            if dim is not None:
+                raise ValueError("The `data_range` must be given when `dim` is not None.")
+            self.data_range = None
+            self.add_state("min_target", jnp.zeros(()), dist_reduce_fx="min")
+            self.add_state("max_target", jnp.zeros(()), dist_reduce_fx="max")
+        else:
+            self.add_state("data_range", jnp.asarray(float(data_range)), dist_reduce_fx="mean")
+        self.base = base
+        self.reduction = reduction
+        self.dim = tuple(dim) if isinstance(dim, Sequence) else dim
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        sum_squared_error, n_obs = _psnr_update(preds, target, dim=self.dim)
+        if self.dim is None:
+            if self.data_range is None:
+                self.min_target = jnp.minimum(target.min(), self.min_target)
+                self.max_target = jnp.maximum(target.max(), self.max_target)
+            self.sum_squared_error = self.sum_squared_error + sum_squared_error
+            self.total = self.total + n_obs
+        else:
+            self.sum_squared_error.append(sum_squared_error)
+            self.total.append(n_obs)
+
+    def compute(self) -> Array:
+        data_range = (
+            self.data_range if self.data_range is not None else self.max_target - self.min_target
+        )
+        if self.dim is None:
+            sum_squared_error = self.sum_squared_error
+            total = self.total
+        else:
+            sum_squared_error = jnp.concatenate([jnp.ravel(v) for v in self.sum_squared_error])
+            total = jnp.concatenate([jnp.ravel(v) for v in self.total])
+        return _psnr_compute(sum_squared_error, total, data_range, base=self.base, reduction=self.reduction)

@@ -1,0 +1,6 @@
+from metrics_tpu.models.inception import (  # noqa: F401
+    InceptionFeatureExtractor,
+    inception_v3_apply,
+    inception_v3_init,
+    load_torch_inception_weights,
+)

@@ -1,0 +1,197 @@
+"""LPIPS perceptual-similarity network as a pure-JAX XLA graph.
+
+TPU-native replacement for the reference's wrap of the ``lpips`` torch
+package (``torchmetrics/image/lpip_similarity.py:22-33``): AlexNet / VGG16
+feature towers (torchvision topology), per-layer unit normalization, learned
+1x1 linear heads, spatial averaging — one jittable function.
+
+Weight parity: tower weights convert from torchvision ``alexnet``/``vgg16``
+state dicts, linear-head weights from an ``lpips`` package checkpoint, via
+:func:`load_torch_lpips_weights`. Random deterministic init otherwise (the
+mechanism is exact; scores then aren't comparable to published LPIPS numbers).
+"""
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array, lax
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+# (out_channels, kernel, stride, padding) per conv; None marks a 3x3/2 maxpool
+_ALEX_CFG: Sequence = [
+    (64, 11, 4, 2), "M", (192, 5, 1, 2), "M", (384, 3, 1, 1), (256, 3, 1, 1), (256, 3, 1, 1),
+]
+_ALEX_TAPS = (0, 2, 4, 5, 6)  # conv indices whose relu output is a tap
+_VGG_CFG: Sequence = [
+    (64, 3, 1, 1), (64, 3, 1, 1), "M",
+    (128, 3, 1, 1), (128, 3, 1, 1), "M",
+    (256, 3, 1, 1), (256, 3, 1, 1), (256, 3, 1, 1), "M",
+    (512, 3, 1, 1), (512, 3, 1, 1), (512, 3, 1, 1), "M",
+    (512, 3, 1, 1), (512, 3, 1, 1), (512, 3, 1, 1),
+]
+_VGG_TAPS = (1, 3, 6, 9, 12)  # relu1_2, relu2_2, relu3_3, relu4_3, relu5_3
+
+# lpips input normalization (applied to [-1, 1] inputs)
+_SHIFT = np.array([-0.030, -0.088, -0.188], dtype=np.float32)
+_SCALE = np.array([0.458, 0.448, 0.450], dtype=np.float32)
+
+
+def _tower_cfg(net: str) -> Tuple[Sequence, Sequence[int]]:
+    if net == "alex":
+        return _ALEX_CFG, _ALEX_TAPS
+    if net == "vgg":
+        return _VGG_CFG, _VGG_TAPS
+    raise ValueError(f"Unknown LPIPS net {net!r}; expected 'alex' or 'vgg'.")
+
+
+def lpips_init(net: str = "alex", key: Optional[Array] = None) -> Dict[str, Any]:
+    """Initialize params: conv tower + per-tap 1x1 linear heads."""
+    cfg, taps = _tower_cfg(net)
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    convs: List[Dict[str, Array]] = []
+    cin = 3
+    tap_dims = []
+    conv_idx = 0
+    for item in cfg:
+        if item == "M":
+            continue
+        cout, kh, _, _ = item
+        key, sub = jax.random.split(key)
+        std = float(np.sqrt(2.0 / (cin * kh * kh)))
+        convs.append({
+            "kernel": jax.random.normal(sub, (kh, kh, cin, cout), dtype=jnp.float32) * std,
+            "bias": jnp.zeros((cout,)),
+        })
+        if conv_idx in taps:
+            tap_dims.append(cout)
+        cin = cout
+        conv_idx += 1
+    key, sub = jax.random.split(key)
+    lins = [
+        jnp.abs(jax.random.normal(k, (d,), dtype=jnp.float32)) * 0.1
+        for k, d in zip(jax.random.split(sub, len(tap_dims)), tap_dims)
+    ]
+    return {"convs": convs, "lins": lins}
+
+
+def _tower_features(params: Dict[str, Any], x: Array, net: str) -> List[Array]:
+    """Run the conv tower (NHWC) returning the tapped relu outputs."""
+    cfg, taps = _tower_cfg(net)
+    feats: List[Array] = []
+    conv_idx = 0
+    i = 0
+    for item in cfg:
+        if item == "M":
+            x = lax.reduce_window(
+                x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+            )
+            continue
+        _, _, stride, pad = item
+        p = params["convs"][i]
+        x = lax.conv_general_dilated(
+            x, p["kernel"], window_strides=(stride, stride),
+            padding=((pad, pad), (pad, pad)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + p["bias"]
+        x = jax.nn.relu(x)
+        if conv_idx in taps:
+            feats.append(x)
+        i += 1
+        conv_idx += 1
+    return feats
+
+
+def lpips_apply(params: Dict[str, Any], img0: Array, img1: Array, net: str = "alex",
+                normalize: bool = False) -> Array:
+    """LPIPS distance per image pair.
+
+    Args:
+        img0 / img1: [N, 3, H, W] (NCHW, matching the reference API).
+        net: tower topology ('alex' | 'vgg') — static, not part of params.
+        normalize: inputs are in [0, 1] (rescaled to [-1, 1]); else [-1, 1].
+    """
+    if normalize:
+        img0 = 2 * img0 - 1
+        img1 = 2 * img1 - 1
+    shift = jnp.asarray(_SHIFT)
+    scale = jnp.asarray(_SCALE)
+
+    def prep(x: Array) -> Array:
+        x = jnp.transpose(x, (0, 2, 3, 1))  # -> NHWC
+        return (x - shift) / scale
+
+    f0 = _tower_features(params, prep(img0), net)
+    f1 = _tower_features(params, prep(img1), net)
+    total = jnp.zeros((img0.shape[0],))
+    for a, b, lin in zip(f0, f1, params["lins"]):
+        a = a / jnp.sqrt(jnp.sum(a * a, axis=-1, keepdims=True) + 1e-10)
+        b = b / jnp.sqrt(jnp.sum(b * b, axis=-1, keepdims=True) + 1e-10)
+        diff = (a - b) ** 2
+        total = total + jnp.mean(diff @ lin, axis=(1, 2))  # 1x1 head + spatial mean
+    return total
+
+
+def load_torch_lpips_weights(
+    net: str, tower_state_dict: Any, lin_state_dict: Optional[Any] = None
+) -> Dict[str, Any]:
+    """Build params from torchvision tower weights (+ optional ``lpips``
+    package linear-head weights, keys ``lin<k>.model.1.weight``)."""
+    import torch  # local import; tower conversion is host-side only
+
+    if not isinstance(tower_state_dict, dict):
+        tower_state_dict = torch.load(tower_state_dict, map_location="cpu")
+    sd = {k: np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+          for k, v in tower_state_dict.items()}
+    params = lpips_init(net)
+    conv_keys = [k for k in sd if k.startswith("features.") and k.endswith(".weight") and sd[k].ndim == 4]
+    conv_keys.sort(key=lambda k: int(k.split(".")[1]))
+    if len(conv_keys) != len(params["convs"]):
+        raise ValueError(
+            f"Tower state dict has {len(conv_keys)} convs, expected {len(params['convs'])} for {net!r}."
+        )
+    for i, wk in enumerate(conv_keys):
+        bk = wk.replace(".weight", ".bias")
+        params["convs"][i] = {
+            "kernel": jnp.asarray(sd[wk].transpose(2, 3, 1, 0)),
+            "bias": jnp.asarray(sd[bk]),
+        }
+    if lin_state_dict is not None:
+        if not isinstance(lin_state_dict, dict):
+            lin_state_dict = torch.load(lin_state_dict, map_location="cpu")
+        lsd = {k: np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+               for k, v in lin_state_dict.items()}
+        for i in range(len(params["lins"])):
+            key = f"lin{i}.model.1.weight"
+            params["lins"][i] = jnp.asarray(lsd[key].reshape(-1))
+    return params
+
+
+class LPIPSNetwork:
+    """Callable ``(img0, img1) -> per-pair distance`` wrapping the jitted
+    LPIPS forward — analogue of the reference's ``NoTrainLpips``
+    (``image/lpip_similarity.py:22-33``)."""
+
+    def __init__(self, net: str = "alex", weights: Optional[Tuple[Any, Any]] = None) -> None:
+        if net not in ("alex", "vgg"):
+            raise ValueError(f"Argument `net_type` must be one of ('alex', 'vgg'), got {net}")
+        if weights is not None:
+            tower, lin = weights
+            self.params = load_torch_lpips_weights(net, tower, lin)
+        else:
+            rank_zero_warn(
+                "LPIPSNetwork initialized with RANDOM weights: metric mechanics are"
+                " exact but scores are not comparable with the lpips package."
+                " Pass `weights=(tower_state_dict, lin_state_dict)` for parity."
+            )
+            self.params = lpips_init(net)
+        self.net_type = net
+        self._fwd = jax.jit(
+            lambda p, a, b, normalize: lpips_apply(p, a, b, net, normalize),
+            static_argnames=("normalize",),
+        )
+
+    def __call__(self, img0: Array, img1: Array, normalize: bool = False) -> Array:
+        return self._fwd(self.params, img0, img1, normalize)

@@ -22,6 +22,7 @@ class GroupedByQuery(NamedTuple):
     rank: Array        # [N] 1-based rank within the group (by score desc)
     num_groups: int    # number of distinct queries (static for jit callers)
     group_sizes: Array  # [G]
+    group_start: Array  # [G] position of each group's first row
 
 
 def group_by_query(indexes: Array, preds: Array, target: Array, num_groups: Optional[int] = None) -> GroupedByQuery:
@@ -44,7 +45,7 @@ def group_by_query(indexes: Array, preds: Array, target: Array, num_groups: Opti
     group_start = jax.ops.segment_min(positions, gid, num_segments=num_groups)
     rank = positions - group_start[gid] + 1
     group_sizes = jax.ops.segment_sum(jnp.ones_like(gid), gid, num_segments=num_groups)
-    return GroupedByQuery(preds_s, target_s, gid, rank, num_groups, group_sizes)
+    return GroupedByQuery(preds_s, target_s, gid, rank, num_groups, group_sizes, group_start)
 
 
 def segment_sum(values: Array, g: GroupedByQuery) -> Array:
@@ -58,8 +59,7 @@ def segment_min(values: Array, g: GroupedByQuery) -> Array:
 def segment_cumsum(values: Array, g: GroupedByQuery) -> Array:
     """Within-group cumulative sum (inclusive) for sorted segments."""
     prefix = jnp.cumsum(values)
-    positions = jnp.arange(values.shape[0])
-    start = jax.ops.segment_min(positions, g.gid, num_segments=g.num_groups)
+    start = g.group_start
     # prefix value just before each group's first row
     before = jnp.where(start > 0, prefix[jnp.maximum(start - 1, 0)], 0)
     return prefix - before[g.gid]
@@ -71,6 +71,5 @@ def relevance_sorted(g: GroupedByQuery):
     used for IDCG."""
     order = jnp.lexsort((-g.target, g.gid))
     positions = jnp.arange(g.gid.shape[0])
-    start = jax.ops.segment_min(positions, g.gid, num_segments=g.num_groups)
-    rank_sorted = positions - start[g.gid] + 1
+    rank_sorted = positions - g.group_start[g.gid] + 1
     return g.target[order], rank_sorted

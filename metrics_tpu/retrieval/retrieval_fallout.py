@@ -8,6 +8,7 @@ from jax import Array
 
 from metrics_tpu.ops.segment import GroupedByQuery, segment_sum
 from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utils.checks import _check_retrieval_k
 
 
 class RetrievalFallOut(RetrievalMetric):
@@ -32,8 +33,7 @@ class RetrievalFallOut(RetrievalMetric):
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
         )
-        if (k is not None) and not (isinstance(k, int) and k > 0):
-            raise ValueError("`k` has to be a positive integer or None")
+        _check_retrieval_k(k)
         self.k = k
 
     def _segment_metric(self, g: GroupedByQuery) -> Array:

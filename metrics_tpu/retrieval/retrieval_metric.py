@@ -66,11 +66,11 @@ class RetrievalMetric(Metric, ABC):
         self.target.append(target)
 
     def compute(self) -> Array:
+        if not self.preds:
+            return jnp.asarray(0.0)
         indexes = dim_zero_cat(self.indexes)
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
-        if preds.size == 0:
-            return jnp.asarray(0.0)
 
         g = group_by_query(indexes, preds, target)
         scores = self._segment_metric(g)  # [G]
@@ -82,7 +82,8 @@ class RetrievalMetric(Metric, ABC):
 
         if self.empty_target_action == "error":
             if bool(jnp.any(empty)):
-                raise ValueError("`compute` method was provided with a query with no positive target.")
+                kind = "negative" if self.empty_on_negatives else "positive"
+                raise ValueError(f"`compute` method was provided with a query with no {kind} target.")
             return jnp.mean(scores)
         if self.empty_target_action == "skip":
             valid = ~empty

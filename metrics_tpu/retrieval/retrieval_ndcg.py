@@ -7,6 +7,7 @@ from jax import Array
 
 from metrics_tpu.ops.segment import GroupedByQuery, relevance_sorted, segment_sum
 from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utils.checks import _check_retrieval_k
 
 
 class RetrievalNormalizedDCG(RetrievalMetric):
@@ -30,8 +31,7 @@ class RetrievalNormalizedDCG(RetrievalMetric):
             process_group=process_group,
             dist_sync_fn=dist_sync_fn,
         )
-        if (k is not None) and not (isinstance(k, int) and k > 0):
-            raise ValueError("`k` has to be a positive integer or None")
+        _check_retrieval_k(k)
         self.k = k
 
     def _segment_metric(self, g: GroupedByQuery) -> Array:

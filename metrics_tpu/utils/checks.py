@@ -331,3 +331,10 @@ def _check_retrieval_inputs(
         raise ValueError("`indexes` must be a tensor of long integers")
     preds, target = _check_retrieval_target_and_prediction_types(preds, target, allow_non_binary_target)
     return indexes.astype(jnp.int32).ravel(), preds, target
+
+
+def _check_retrieval_k(k) -> None:
+    """Shared top-k validation for retrieval metrics (module + functional
+    layers); rejects bools, which python counts as ints."""
+    if k is not None and (isinstance(k, bool) or not isinstance(k, int) or k <= 0):
+        raise ValueError("`k` has to be a positive integer or None")
