@@ -64,9 +64,14 @@ from metrics_tpu.retrieval import (
     RetrievalPrecision,
     RetrievalRecall,
 )
+from metrics_tpu.audio import PIT, SI_SDR, SI_SNR, SNR
 from metrics_tpu.wrappers import BootStrapper, MetricTracker
 
 __all__ = [
+    "PIT",
+    "SI_SDR",
+    "SI_SNR",
+    "SNR",
     "AUC",
     "AUROC",
     "Accuracy",

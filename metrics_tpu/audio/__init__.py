@@ -1,0 +1,6 @@
+from metrics_tpu.audio.pit import PIT
+from metrics_tpu.audio.si_sdr import SI_SDR
+from metrics_tpu.audio.si_snr import SI_SNR
+from metrics_tpu.audio.snr import SNR
+
+__all__ = ["PIT", "SI_SDR", "SI_SNR", "SNR"]

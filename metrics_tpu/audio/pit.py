@@ -1,0 +1,59 @@
+"""PIT module — analogue of reference ``torchmetrics/audio/pit.py`` (116 LoC)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio.pit import pit
+
+
+class PIT(Metric):
+    """Permutation-invariant training metric wrapper.
+
+    Forward accepts ``preds``/``target`` of shape ``[batch, spk, ...]``; the
+    wrapped pairwise ``metric_func`` is evaluated under the best speaker
+    permutation per sample (see :func:`metrics_tpu.functional.audio.pit`).
+
+    Args:
+        metric_func: batched pairwise metric ``(preds, target) -> [batch]``.
+        eval_func: ``'max'`` or ``'min'`` — whether larger metric is better.
+        kwargs: extra args forwarded to ``metric_func``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional.audio import si_snr
+        >>> preds = jnp.array([[[-0.0579, 0.3560, -0.9604], [-0.1719, 0.3205, 0.2951]]])
+        >>> target = jnp.array([[[1.0958, -0.1648, 0.5228], [-0.4100, 1.1942, -0.5103]]])
+        >>> p = PIT(si_snr, 'max')
+        >>> val = p(preds, target)
+    """
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        eval_func: str = "max",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        self.metric_func = metric_func
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+        self.add_state("sum_pit_metric", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        best_metric = pit(preds, target, self.metric_func, self.eval_func, **self.kwargs)[0]
+        self.sum_pit_metric = self.sum_pit_metric + jnp.sum(best_metric)
+        self.total = self.total + best_metric.size
+
+    def compute(self) -> Array:
+        return self.sum_pit_metric / self.total
+
+    @property
+    def is_differentiable(self) -> bool:
+        return True

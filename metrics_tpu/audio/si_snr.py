@@ -1,0 +1,45 @@
+"""SI_SNR module — analogue of reference ``torchmetrics/audio/si_snr.py`` (103 LoC)."""
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.audio.si_snr import si_snr
+
+
+class SI_SNR(Metric):
+    r"""Scale-invariant signal-to-noise ratio, averaged over signals.
+
+    Forward accepts ``preds``/``target`` of shape ``[..., time]``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> float(SI_SNR()(preds, target))  # doctest: +ELLIPSIS
+        15.09...
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        self.add_state("sum_si_snr", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
+        batch_vals = si_snr(preds=preds, target=target)
+        self.sum_si_snr = self.sum_si_snr + jnp.sum(batch_vals)
+        self.total = self.total + batch_vals.size
+
+    def compute(self) -> Array:
+        return self.sum_si_snr / self.total
+
+    @property
+    def is_differentiable(self) -> bool:
+        return True

@@ -45,3 +45,7 @@ from metrics_tpu.functional.retrieval.ndcg import retrieval_normalized_dcg
 from metrics_tpu.functional.retrieval.precision import retrieval_precision
 from metrics_tpu.functional.retrieval.recall import retrieval_recall
 from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
+from metrics_tpu.functional.audio.pit import pit, pit_permutate
+from metrics_tpu.functional.audio.si_sdr import si_sdr
+from metrics_tpu.functional.audio.si_snr import si_snr
+from metrics_tpu.functional.audio.snr import snr

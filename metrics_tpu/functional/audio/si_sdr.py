@@ -1,0 +1,44 @@
+"""Scale-invariant SDR — analogue of reference
+``torchmetrics/functional/audio/si_sdr.py:20-64``.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def si_sdr(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """Scale-invariant signal-to-distortion ratio.
+
+    Projects ``preds`` onto ``target`` (optimal scaling ``alpha``) and measures
+    the residual energy ratio in dB.
+
+    Args:
+        preds: shape ``[..., time]``
+        target: shape ``[..., time]``
+        zero_mean: subtract the time-mean from both signals first
+
+    Returns:
+        si-sdr value of shape ``[...]``
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> float(si_sdr(preds, target))  # doctest: +ELLIPSIS
+        18.40...
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + eps) / (
+        jnp.sum(target * target, axis=-1, keepdims=True) + eps
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    ratio = (jnp.sum(target_scaled * target_scaled, axis=-1) + eps) / (
+        jnp.sum(noise * noise, axis=-1) + eps
+    )
+    return 10 * jnp.log10(ratio)

@@ -1,0 +1,26 @@
+"""Scale-invariant SNR — analogue of reference
+``torchmetrics/functional/audio/si_snr.py:19-46``: SI-SDR with zero-mean.
+"""
+from jax import Array
+
+from metrics_tpu.functional.audio.si_sdr import si_sdr
+
+
+def si_snr(preds: Array, target: Array) -> Array:
+    """Scale-invariant signal-to-noise ratio.
+
+    Args:
+        preds: shape ``[..., time]``
+        target: shape ``[..., time]``
+
+    Returns:
+        si-snr value of shape ``[...]``
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> float(si_snr(preds, target))  # doctest: +ELLIPSIS
+        15.09...
+    """
+    return si_sdr(preds=preds, target=target, zero_mean=True)

@@ -1,0 +1,37 @@
+"""Signal-to-noise ratio — analogue of reference
+``torchmetrics/functional/audio/snr.py:21-67``.
+
+Pure jnp, vectorized over all leading dims, jittable.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.utils.checks import _check_same_shape
+
+
+def snr(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    r"""Signal-to-noise ratio: :math:`10 \log_{10}(P_{signal} / P_{noise})`.
+
+    Args:
+        preds: shape ``[..., time]``
+        target: shape ``[..., time]``
+        zero_mean: subtract the time-mean from both signals first
+
+    Returns:
+        snr value of shape ``[...]``
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> float(snr(preds, target))  # doctest: +ELLIPSIS
+        16.18...
+    """
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    noise = target - preds
+    ratio = (jnp.sum(target * target, axis=-1) + eps) / (jnp.sum(noise * noise, axis=-1) + eps)
+    return 10 * jnp.log10(ratio)

@@ -5,7 +5,6 @@ instead of a wrapped third-party torch package."""
 from typing import Any, Callable, Optional, Tuple, Union
 
 import jax.numpy as jnp
-import numpy as np
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
@@ -13,11 +12,15 @@ from metrics_tpu.models.lpips_net import LPIPSNetwork
 
 
 def _valid_img(img: Array) -> bool:
-    """[N, 3, H, W] with values in [-1, 1] (reference ``lpip_similarity.py:36-38``)."""
+    """[N, 3, H, W] with values in [-1, 1] (reference ``lpip_similarity.py:36-38``).
+
+    Range check is a single device-side reduction (one scalar transfer), not a
+    host copy of the batch.
+    """
     shape_ok = img.ndim == 4 and img.shape[1] == 3
     if not shape_ok:
         return False
-    return bool(np.asarray(img).min() >= -1.0) and bool(np.asarray(img).max() <= 1.0)
+    return bool(jnp.all(jnp.abs(img) <= 1.0))
 
 
 class LPIPS(Metric):

@@ -43,7 +43,8 @@ class PSNR(Metric):
 
         if dim is None:
             self.add_state("sum_squared_error", jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            # pixel counts overflow int32 on large datasets; float32 accumulates safely
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
         else:
             self.add_state("sum_squared_error", [], dist_reduce_fx="cat")
             self.add_state("total", [], dist_reduce_fx="cat")

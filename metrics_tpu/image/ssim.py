@@ -57,7 +57,8 @@ class SSIM(Metric):
         self._streaming = data_range is not None and reduction in ("elementwise_mean", "sum")
         if self._streaming:
             self.add_state("similarity_sum", jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("total", jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+            # pixel counts overflow int32 on large datasets; float32 accumulates safely
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
         else:
             rank_zero_warn(
                 "Metric `SSIM` will save all targets and predictions in buffer"

@@ -19,9 +19,10 @@ from jax import Array, lax
 
 from metrics_tpu.utils.prints import rank_zero_warn
 
-# (out_channels, kernel, stride, padding) per conv; None marks a 3x3/2 maxpool
+# (out_channels, kernel, stride, padding) per conv; "M3" = 3x3/2 maxpool
+# (AlexNet, torchvision MaxPool2d(3, 2)), "M" = 2x2/2 maxpool (VGG)
 _ALEX_CFG: Sequence = [
-    (64, 11, 4, 2), "M", (192, 5, 1, 2), "M", (384, 3, 1, 1), (256, 3, 1, 1), (256, 3, 1, 1),
+    (64, 11, 4, 2), "M3", (192, 5, 1, 2), "M3", (384, 3, 1, 1), (256, 3, 1, 1), (256, 3, 1, 1),
 ]
 _ALEX_TAPS = (0, 2, 4, 5, 6)  # conv indices whose relu output is a tap
 _VGG_CFG: Sequence = [
@@ -56,7 +57,7 @@ def lpips_init(net: str = "alex", key: Optional[Array] = None) -> Dict[str, Any]
     tap_dims = []
     conv_idx = 0
     for item in cfg:
-        if item == "M":
+        if isinstance(item, str):
             continue
         cout, kh, _, _ = item
         key, sub = jax.random.split(key)
@@ -84,9 +85,10 @@ def _tower_features(params: Dict[str, Any], x: Array, net: str) -> List[Array]:
     conv_idx = 0
     i = 0
     for item in cfg:
-        if item == "M":
+        if isinstance(item, str):
+            w = 3 if item == "M3" else 2
             x = lax.reduce_window(
-                x, -jnp.inf, lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+                x, -jnp.inf, lax.max, (1, w, w, 1), (1, 2, 2, 1), "VALID"
             )
             continue
         _, _, stride, pad = item
