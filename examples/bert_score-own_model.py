@@ -1,0 +1,110 @@
+"""BERTScore with a user-defined model and tokenizer (JAX).
+
+Port of the reference acceptance example
+(``tm_examples/bert_score-own_model.py``): a custom tokenizer that emits word
+*embeddings* as ``input_ids`` plus a small self-attention encoder, plugged
+into :class:`metrics_tpu.BERTScore` through ``user_forward_fn``.
+
+To run: python examples/bert_score-own_model.py
+"""
+from pprint import pprint
+from typing import Dict, List, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import BERTScore
+
+_NUM_LAYERS = 2
+_MODEL_DIM = 4
+_NHEAD = 2
+_MAX_LEN = 6
+
+
+class UserTokenizer:
+    """Required when a non-default model is used: maps sentences to a dict of
+    ``input_ids`` (here: word embeddings) and ``attention_mask`` arrays,
+    framing each sentence with CLS/SEP equivalents and padding to max_len."""
+
+    CLS_TOKEN = "<cls>"
+    SEP_TOKEN = "<sep>"
+    PAD_TOKEN = "<pad>"
+
+    def __init__(self) -> None:
+        self.word2vec = {
+            "hello": 0.5 * np.ones((1, _MODEL_DIM), dtype=np.float32),
+            "world": -0.5 * np.ones((1, _MODEL_DIM), dtype=np.float32),
+            self.CLS_TOKEN: np.zeros((1, _MODEL_DIM), dtype=np.float32),
+            self.SEP_TOKEN: np.zeros((1, _MODEL_DIM), dtype=np.float32),
+            self.PAD_TOKEN: np.zeros((1, _MODEL_DIM), dtype=np.float32),
+        }
+
+    def __call__(
+        self, sentences: Union[str, List[str]], max_len: int = _MAX_LEN
+    ) -> Dict[str, np.ndarray]:
+        if isinstance(sentences, str):
+            sentences = [sentences]
+        sentences = [" ".join([self.CLS_TOKEN, s, self.SEP_TOKEN]) for s in sentences]
+        tokenized = [
+            s.lower().split()[:max_len] + [self.PAD_TOKEN] * (max_len - len(s.lower().split()))
+            for s in sentences
+        ]
+        return {
+            "input_ids": np.stack(
+                [np.concatenate([self.word2vec[w] for w in s]) for s in tokenized]
+            ),
+            "attention_mask": np.stack(
+                [[1 if w != self.PAD_TOKEN else 0 for w in s] for s in tokenized]
+            ).astype(np.int32),
+        }
+
+
+def get_user_model_encoder(num_layers: int = _NUM_LAYERS, d_model: int = _MODEL_DIM, nhead: int = _NHEAD):
+    """A tiny deterministic transformer encoder as (params, apply)."""
+    key = jax.random.PRNGKey(42)
+    params = []
+    for _ in range(num_layers):
+        key, k1, k2 = jax.random.split(key, 3)
+        params.append(
+            {
+                "qkv": jax.random.normal(k1, (d_model, 3 * d_model)) * 0.3,
+                "ffn": jax.random.normal(k2, (d_model, d_model)) * 0.3,
+            }
+        )
+
+    def apply(x: jnp.ndarray) -> jnp.ndarray:
+        head_dim = d_model // nhead
+        for layer in params:
+            qkv = x @ layer["qkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            split = lambda t: t.reshape(t.shape[0], t.shape[1], nhead, head_dim).transpose(0, 2, 1, 3)  # noqa: E731
+            attn = jax.nn.softmax(
+                jnp.einsum("bhqd,bhkd->bhqk", split(q), split(k)) / jnp.sqrt(head_dim), axis=-1
+            )
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, split(v))
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(x.shape)
+            x = x + ctx
+            x = x + jax.nn.relu(x @ layer["ffn"])
+        return x
+
+    return apply
+
+
+def user_forward_fn(model, batch: Dict[str, jnp.ndarray]) -> jnp.ndarray:
+    """(model, batch) -> [batch, seq_len, model_dim] embeddings."""
+    return model(jnp.asarray(batch["input_ids"]))
+
+
+_PREDS = ["hello", "hello world", "world world world"]
+_REFS = ["hello", "hello hello", "hello world hello"]
+
+
+if __name__ == "__main__":
+    tokenizer = UserTokenizer()
+    model = get_user_model_encoder()
+    metric = BERTScore(
+        model=model, user_tokenizer=tokenizer, user_forward_fn=user_forward_fn, max_length=_MAX_LEN
+    )
+    metric.update(_PREDS, _REFS)
+    pprint(metric.compute())

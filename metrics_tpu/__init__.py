@@ -65,9 +65,14 @@ from metrics_tpu.retrieval import (
     RetrievalRecall,
 )
 from metrics_tpu.audio import PIT, SI_SDR, SI_SNR, SNR
+from metrics_tpu.text import BERTScore, BLEUScore, ROUGEScore, WER
 from metrics_tpu.wrappers import BootStrapper, MetricTracker
 
 __all__ = [
+    "BERTScore",
+    "BLEUScore",
+    "ROUGEScore",
+    "WER",
     "PIT",
     "SI_SDR",
     "SI_SNR",

@@ -49,3 +49,8 @@ from metrics_tpu.functional.audio.pit import pit, pit_permutate
 from metrics_tpu.functional.audio.si_sdr import si_sdr
 from metrics_tpu.functional.audio.si_snr import si_snr
 from metrics_tpu.functional.audio.snr import snr
+from metrics_tpu.functional.self_supervised import embedding_similarity
+from metrics_tpu.functional.text.bert import bert_score
+from metrics_tpu.functional.text.bleu import bleu_score
+from metrics_tpu.functional.text.rouge import rouge_score
+from metrics_tpu.functional.text.wer import wer

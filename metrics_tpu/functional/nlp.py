@@ -1,0 +1,23 @@
+"""Deprecated alias for :func:`metrics_tpu.functional.text.bleu.bleu_score`
+(API-parity shim, reference ``torchmetrics/functional/nlp.py``)."""
+from typing import Sequence
+from warnings import warn
+
+from jax import Array
+
+from metrics_tpu.functional.text.bleu import bleu_score as _bleu_score
+
+
+def bleu_score(
+    reference_corpus: Sequence[Sequence[Sequence[str]]],
+    translate_corpus: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """Deprecated — use :func:`metrics_tpu.functional.text.bleu.bleu_score`."""
+    warn(
+        "Function `functional.nlp.bleu_score` is deprecated. "
+        "Use `functional.text.bleu.bleu_score` instead.",
+        DeprecationWarning,
+    )
+    return _bleu_score(reference_corpus, translate_corpus, n_gram, smooth)

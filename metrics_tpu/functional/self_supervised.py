@@ -1,0 +1,34 @@
+"""Pairwise embedding similarity — analogue of reference
+``torchmetrics/functional/self_supervised.py`` (56 LoC)."""
+import jax.numpy as jnp
+from jax import Array
+
+
+def embedding_similarity(
+    batch: Array, similarity: str = "cosine", reduction: str = "none", zero_diagonal: bool = True
+) -> Array:
+    """Pairwise representation similarity matrix.
+
+    Args:
+        batch: embeddings ``[batch, dim]``.
+        similarity: ``'dot'`` or ``'cosine'``.
+        reduction: ``'none'`` | ``'sum'`` | ``'mean'`` along the last dim.
+        zero_diagonal: zero self-similarities.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> embeddings = jnp.array([[1., 2., 3., 4.], [1., 2., 3., 4.], [4., 5., 6., 7.]])
+        >>> sim = embedding_similarity(embeddings)
+        >>> sim.shape
+        (3, 3)
+    """
+    if similarity == "cosine":
+        batch = batch / jnp.linalg.norm(batch, axis=1, keepdims=True)
+    sqr_mtx = batch @ batch.T
+    if zero_diagonal:
+        sqr_mtx = sqr_mtx * (1 - jnp.eye(sqr_mtx.shape[0], dtype=sqr_mtx.dtype))
+    if reduction == "mean":
+        sqr_mtx = jnp.mean(sqr_mtx, axis=-1)
+    elif reduction == "sum":
+        sqr_mtx = jnp.sum(sqr_mtx, axis=-1)
+    return sqr_mtx

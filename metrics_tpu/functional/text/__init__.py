@@ -1,0 +1,6 @@
+from metrics_tpu.functional.text.bert import bert_score
+from metrics_tpu.functional.text.bleu import bleu_score
+from metrics_tpu.functional.text.rouge import rouge_score
+from metrics_tpu.functional.text.wer import wer
+
+__all__ = ["bert_score", "bleu_score", "rouge_score", "wer"]

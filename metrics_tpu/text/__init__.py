@@ -1,0 +1,6 @@
+from metrics_tpu.text.bert import BERTScore
+from metrics_tpu.text.bleu import BLEUScore
+from metrics_tpu.text.rouge import ROUGEScore
+from metrics_tpu.text.wer import WER
+
+__all__ = ["BERTScore", "BLEUScore", "ROUGEScore", "WER"]

@@ -1,0 +1,48 @@
+"""WER module — analogue of reference ``torchmetrics/text/wer.py`` (112 LoC)."""
+from typing import Any, Callable, List, Optional, Union
+
+import jax.numpy as jnp
+from jax import Array
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.functional.text.wer import _wer_compute, _wer_update
+
+
+class WER(Metric):
+    r"""Word error rate: ``(S + D + I) / N`` accumulated over batches.
+
+    Strings are processed on host; only the two scalar counters are device
+    state (sum-reduced across ranks).
+
+    Example:
+        >>> predictions = ["this is the prediction", "there is an other sample"]
+        >>> references = ["this is the reference", "there is another one"]
+        >>> metric = WER()
+        >>> float(metric(predictions, references))
+        0.5
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        self.add_state("errors", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(  # type: ignore[override]
+        self, predictions: Union[str, List[str]], references: Union[str, List[str]]
+    ) -> None:
+        errors, total = _wer_update(predictions, references)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _wer_compute(self.errors, self.total)
+
+    @property
+    def is_differentiable(self) -> bool:
+        return False

@@ -1,0 +1,195 @@
+"""BERTScore tests: mechanism correctness with deterministic models (the
+reference compares against the `bert_score` package with a pretrained BERT —
+unavailable offline, so these tests pin the algorithm itself)."""
+from typing import Dict, List, Union
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import BERTScore
+from metrics_tpu.functional import bert_score
+
+_PREDS = ["hello there", "general kenobi"]
+_REFS = ["hello there", "master kenobi"]
+
+
+def test_identical_sentences_score_one():
+    out = bert_score(predictions=_PREDS, references=_PREDS, max_length=16)
+    np.testing.assert_allclose(out["precision"], 1.0, atol=1e-3)
+    np.testing.assert_allclose(out["recall"], 1.0, atol=1e-3)
+    np.testing.assert_allclose(out["f1"], 1.0, atol=1e-3)
+
+
+def test_precision_recall_symmetry():
+    a = bert_score(predictions=_PREDS, references=_REFS, max_length=16)
+    b = bert_score(predictions=_REFS, references=_PREDS, max_length=16)
+    np.testing.assert_allclose(a["precision"], b["recall"], atol=1e-6)
+    np.testing.assert_allclose(a["recall"], b["precision"], atol=1e-6)
+
+
+def test_module_matches_functional_and_streams():
+    m = BERTScore(max_length=16)
+    m.update(_PREDS[:1], _REFS[:1])
+    m.update(_PREDS[1:], _REFS[1:])
+    streamed = m.compute()
+    batched = bert_score(predictions=_PREDS, references=_REFS, max_length=16)
+    np.testing.assert_allclose(streamed["f1"], batched["f1"], atol=1e-6)
+
+
+def test_module_merge_states():
+    """Cat-state merge across simulated ranks == all-data evaluation (the
+    DDP-sync fix over reference text/bert.py:170-171)."""
+    m1, m2 = BERTScore(max_length=16), BERTScore(max_length=16)
+    m1.update(_PREDS[:1], _REFS[:1])
+    m2.update(_PREDS[1:], _REFS[1:])
+    merged = m1.merge_states(m1._state, m2._state)
+    out = m1.pure_compute(merged)
+    batched = bert_score(predictions=_PREDS, references=_REFS, max_length=16)
+    np.testing.assert_allclose(out["f1"], batched["f1"], atol=1e-6)
+
+
+def test_idf_changes_scores():
+    # "the" appears in every reference (idf 0) while content words are rare,
+    # so idf weighting must shift the weighted average
+    preds = ["the the the cat", "the dog in the park"]
+    refs = ["the cat sat on the mat", "the dog runs in the park"]
+    plain = bert_score(predictions=preds, references=refs, max_length=16)
+    weighted = bert_score(predictions=preds, references=refs, max_length=16, idf=True)
+    assert not np.allclose(plain["f1"], weighted["f1"], atol=1e-6)
+
+
+def test_all_layers_returns_per_layer_scores():
+    out = bert_score(predictions=_PREDS, references=_REFS, max_length=16, all_layers=True)
+    # default in-framework config has 4 layers + embeddings = 5 representations
+    assert np.asarray(out["f1"]).shape == (5, 2)
+
+
+def test_rescale_with_baseline_array():
+    out = bert_score(predictions=_PREDS, references=_REFS, max_length=16)
+    baseline = jnp.full((5, 3), 0.5)
+    rescaled = bert_score(
+        predictions=_PREDS,
+        references=_REFS,
+        max_length=16,
+        rescale_with_baseline=True,
+        baseline=baseline,
+    )
+    np.testing.assert_allclose(
+        rescaled["f1"], (np.asarray(out["f1"]) - 0.5) / 0.5, atol=1e-5
+    )
+
+
+def test_empty_inputs():
+    out = bert_score(predictions=[], references=[])
+    assert out == {"precision": [0.0], "recall": [0.0], "f1": [0.0]}
+
+
+def test_length_mismatch():
+    with pytest.raises(ValueError, match="must be the same"):
+        bert_score(predictions=["a"], references=["a", "b"])
+
+
+def test_return_hash():
+    out = bert_score(predictions=_PREDS, references=_REFS, max_length=16, return_hash=True)
+    assert out["hash"] == "None_LNone_no-idf"
+
+
+# ---------------------------------------------------------------------------
+# own-model path (port of the reference acceptance example
+# tm_examples/bert_score-own_model.py)
+# ---------------------------------------------------------------------------
+
+_MODEL_DIM = 4
+_MAX_LEN = 6
+
+
+class UserTokenizer:
+    """Embedding-valued tokenizer: 'input_ids' are word vectors."""
+
+    CLS, SEP, PAD = "<cls>", "<sep>", "<pad>"
+
+    def __init__(self) -> None:
+        self.word2vec = {
+            "hello": 0.5 * np.ones((1, _MODEL_DIM), dtype=np.float32),
+            "world": -0.5 * np.ones((1, _MODEL_DIM), dtype=np.float32),
+            self.CLS: np.zeros((1, _MODEL_DIM), dtype=np.float32),
+            self.SEP: np.zeros((1, _MODEL_DIM), dtype=np.float32),
+            self.PAD: np.zeros((1, _MODEL_DIM), dtype=np.float32),
+        }
+
+    def __call__(self, sentences: Union[str, List[str]], max_len: int = _MAX_LEN) -> Dict[str, np.ndarray]:
+        if isinstance(sentences, str):
+            sentences = [sentences]
+        sentences = [" ".join([self.CLS, s, self.SEP]) for s in sentences]
+        tokenized = [
+            s.lower().split()[:max_len] + [self.PAD] * (max_len - len(s.lower().split()))
+            for s in sentences
+        ]
+        ids = np.stack([np.concatenate([self.word2vec[w] for w in s]) for s in tokenized])
+        mask = np.stack([[1 if w != self.PAD else 0 for w in s] for s in tokenized]).astype(np.int32)
+        return {"input_ids": ids, "attention_mask": mask}
+
+
+def _user_model(input_ids: np.ndarray) -> np.ndarray:
+    """Deterministic 'encoder': L2-normalize word vectors + positional tilt."""
+    x = jnp.asarray(input_ids)
+    pos = jnp.linspace(0.0, 0.1, x.shape[1])[None, :, None]
+    return x + pos
+
+
+def _user_forward_fn(model, batch):
+    return model(batch["input_ids"])
+
+
+_OWN_PREDS = ["hello", "hello world", "world world world"]
+_OWN_REFS = ["hello", "hello hello", "hello world hello"]
+
+
+def test_own_model_functional():
+    out = bert_score(
+        predictions=_OWN_PREDS,
+        references=_OWN_REFS,
+        model=_user_model,
+        user_tokenizer=UserTokenizer(),
+        user_forward_fn=_user_forward_fn,
+        max_length=_MAX_LEN,
+    )
+    assert len(out["f1"]) == 3
+    # first pair identical -> perfect score
+    assert out["f1"][0] == pytest.approx(1.0, abs=1e-3)
+    assert all(np.isfinite(out["f1"]))
+
+
+def test_own_model_module():
+    metric = BERTScore(
+        model=_user_model,
+        user_tokenizer=UserTokenizer(),
+        user_forward_fn=_user_forward_fn,
+        max_length=_MAX_LEN,
+    )
+    metric.update(_OWN_PREDS, _OWN_REFS)
+    out = metric.compute()
+    batched = bert_score(
+        predictions=_OWN_PREDS,
+        references=_OWN_REFS,
+        model=_user_model,
+        user_tokenizer=UserTokenizer(),
+        user_forward_fn=_user_forward_fn,
+        max_length=_MAX_LEN,
+    )
+    np.testing.assert_allclose(out["f1"], batched["f1"], atol=1e-6)
+
+
+def test_single_sentence_returns_list():
+    out = bert_score(predictions=["hello there"], references=["hello there"], max_length=16)
+    assert isinstance(out["f1"], list) and len(out["f1"]) == 1
+    assert out["f1"][0] == pytest.approx(1.0, abs=1e-3)
+
+
+def test_simple_tokenizer_stable_across_instances():
+    from metrics_tpu.functional.text.bert import SimpleTokenizer
+
+    a = SimpleTokenizer(max_length=8)(["hello world"])
+    b = SimpleTokenizer(max_length=8)(["hello world"])
+    np.testing.assert_array_equal(a["input_ids"], b["input_ids"])

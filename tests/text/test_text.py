@@ -1,0 +1,256 @@
+"""Text metric tests: golden values, independent hand-rolled references, and
+distributed merge semantics (mirrors the reference's `tests/text/` strategy,
+which compares against jiwer/nltk/rouge-score — absent here, so references
+are independently implemented in-test)."""
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from metrics_tpu import BLEUScore, ROUGEScore, WER
+from metrics_tpu.functional import bleu_score, embedding_similarity, rouge_score, wer
+from metrics_tpu.functional.text.rouge import PorterStemmer
+from metrics_tpu.functional.text.wer import _edit_distance
+
+
+# ---------------------------------------------------------------------------
+# WER
+# ---------------------------------------------------------------------------
+
+
+def _py_edit_distance(a, b):
+    """Plain-python Levenshtein (independent of the vectorized one)."""
+    dp = [[0] * (len(b) + 1) for _ in range(len(a) + 1)]
+    for i in range(len(a) + 1):
+        dp[i][0] = i
+    for j in range(len(b) + 1):
+        dp[0][j] = j
+    for i in range(1, len(a) + 1):
+        for j in range(1, len(b) + 1):
+            if a[i - 1] == b[j - 1]:
+                dp[i][j] = dp[i - 1][j - 1]
+            else:
+                dp[i][j] = min(dp[i - 1][j], dp[i][j - 1], dp[i - 1][j - 1]) + 1
+    return dp[-1][-1]
+
+
+PREDS = ["this is the prediction", "there is an other sample"]
+REFS = ["this is the reference", "there is another one"]
+
+
+def test_wer_golden():
+    assert float(wer(PREDS, REFS)) == pytest.approx(0.5)
+    assert float(wer("hello world", "hello world")) == 0.0
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_edit_distance_vs_python(seed):
+    rng = np.random.RandomState(seed)
+    vocab = ["a", "b", "c", "d", "e"]
+    a = [vocab[i] for i in rng.randint(0, 5, rng.randint(0, 20))]
+    b = [vocab[i] for i in rng.randint(0, 5, rng.randint(0, 20))]
+    assert _edit_distance(a, b) == _py_edit_distance(a, b)
+
+
+def test_wer_class_accumulation_and_merge():
+    m = WER()
+    m.update(PREDS[:1], REFS[:1])
+    m.update(PREDS[1:], REFS[1:])
+    assert float(m.compute()) == pytest.approx(float(wer(PREDS, REFS)))
+
+    # distributed merge: two "ranks" then merge_states == all data
+    m1, m2 = WER(), WER()
+    m1.update(PREDS[:1], REFS[:1])
+    m2.update(PREDS[1:], REFS[1:])
+    merged = m1.merge_states(m1._state, m2._state)
+    assert float(m1.pure_compute(merged)) == pytest.approx(float(wer(PREDS, REFS)))
+
+
+# ---------------------------------------------------------------------------
+# BLEU
+# ---------------------------------------------------------------------------
+
+TRANS = ["the cat is on the mat".split(), "a dog walks in the park".split()]
+REFS_BLEU = [
+    ["there is a cat on the mat".split(), "a cat is on the mat".split()],
+    ["the dog walks in a park".split()],
+]
+
+
+def _py_bleu(refs, trans, n_gram=4, smooth=False):
+    """Independent BLEU: clipped modified precision + brevity penalty."""
+
+    def counts(tokens, n):
+        return Counter(tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1))
+
+    num = np.zeros(n_gram)
+    den = np.zeros(n_gram)
+    t_len = r_len = 0
+    for t, rs in zip(trans, refs):
+        t_len += len(t)
+        diffs = [abs(len(t) - len(r)) for r in rs]
+        r_len += len(rs[int(np.argmin(diffs))])
+        for n in range(1, n_gram + 1):
+            tc = counts(t, n)
+            best = Counter()
+            for r in rs:
+                rc = counts(r, n)
+                for g in rc:
+                    best[g] = max(best[g], rc[g])
+            for g, c in tc.items():
+                num[n - 1] += min(c, best[g])
+                den[n - 1] += c
+    if num.min() == 0 and not smooth:
+        return 0.0
+    if smooth:
+        prec = (num + 1) / (den + 1)
+        prec[0] = num[0] / den[0]
+    else:
+        prec = num / den
+    gm = np.exp(np.mean(np.log(prec)))
+    bp = 1.0 if t_len > r_len else np.exp(1 - r_len / t_len)
+    return float(bp * gm)
+
+
+def test_bleu_golden():
+    tc = ["the cat is on the mat".split()]
+    rc = [["there is a cat on the mat".split(), "a cat is on the mat".split()]]
+    assert float(bleu_score(rc, tc)) == pytest.approx(0.7598, abs=1e-4)
+
+
+@pytest.mark.parametrize("n_gram", [1, 2, 3, 4])
+@pytest.mark.parametrize("smooth", [False, True])
+def test_bleu_vs_python(n_gram, smooth):
+    ours = float(bleu_score(REFS_BLEU, TRANS, n_gram=n_gram, smooth=smooth))
+    theirs = _py_bleu(REFS_BLEU, TRANS, n_gram=n_gram, smooth=smooth)
+    assert ours == pytest.approx(theirs, abs=1e-5)
+
+
+def test_bleu_class_matches_corpus():
+    m = BLEUScore()
+    for t, r in zip(TRANS, REFS_BLEU):
+        m.update([r], [t])
+    assert float(m.compute()) == pytest.approx(float(bleu_score(REFS_BLEU, TRANS)), abs=1e-6)
+
+
+def test_bleu_size_mismatch():
+    with pytest.raises(ValueError, match="Corpus has different size"):
+        bleu_score([["a b".split()]], [])
+
+
+# ---------------------------------------------------------------------------
+# ROUGE
+# ---------------------------------------------------------------------------
+
+
+def _py_rouge1_f(pred, target):
+    p = Counter(pred.lower().split())
+    t = Counter(target.lower().split())
+    hits = sum((p & t).values())
+    if hits == 0:
+        return 0.0
+    prec, rec = hits / sum(p.values()), hits / sum(t.values())
+    return 2 * prec * rec / (prec + rec)
+
+
+def test_rouge_golden():
+    scores = rouge_score("My name is John", "Is your name John")
+    assert float(scores["rouge1_fmeasure"]) == pytest.approx(0.75)
+    assert float(scores["rouge2_fmeasure"]) == pytest.approx(0.0)
+    assert float(scores["rougeL_fmeasure"]) == pytest.approx(0.5)
+
+
+@pytest.mark.parametrize(
+    "pred, target",
+    [
+        ("the quick brown fox", "the quick brown fox"),
+        ("a b c d", "e f g h"),
+        ("one two three four five", "one three five"),
+    ],
+)
+def test_rouge1_vs_python(pred, target):
+    scores = rouge_score(pred, target, rouge_keys="rouge1")
+    assert float(scores["rouge1_fmeasure"]) == pytest.approx(_py_rouge1_f(pred, target), abs=1e-6)
+
+
+def test_rouge_lcs_identity_and_disjoint():
+    same = rouge_score("alpha beta gamma", "alpha beta gamma", rouge_keys="rougeL")
+    assert float(same["rougeL_fmeasure"]) == pytest.approx(1.0)
+    disjoint = rouge_score("alpha beta", "gamma delta", rouge_keys="rougeL")
+    assert float(disjoint["rougeL_fmeasure"]) == 0.0
+
+
+def test_rouge_unknown_key():
+    with pytest.raises(ValueError, match="unknown rouge key"):
+        rouge_score("a", "a", rouge_keys="rouge42")
+    with pytest.raises(ValueError, match="unknown rouge key"):
+        ROUGEScore(rouge_keys="rouge42")
+
+
+def test_rouge_class_accumulation():
+    preds = ["My name is John", "The sky is blue today"]
+    targets = ["Is your name John", "The sky was blue yesterday"]
+    m = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+    for p, t in zip(preds, targets):
+        m.update([p], [t])
+    batched = rouge_score(preds, targets, rouge_keys=("rouge1", "rougeL"))
+    streamed = m.compute()
+    for key in batched:
+        assert float(streamed[key]) == pytest.approx(float(batched[key]), abs=1e-6)
+
+
+@pytest.mark.parametrize(
+    "word, stem",
+    [
+        ("caresses", "caress"),
+        ("ponies", "poni"),
+        ("cats", "cat"),
+        ("agreed", "agre"),
+        ("plastered", "plaster"),
+        ("motoring", "motor"),
+        ("conflated", "conflat"),
+        ("hopping", "hop"),
+        ("happy", "happi"),
+        ("relational", "relat"),
+        ("generalizations", "gener"),
+        ("oscillators", "oscil"),
+    ],
+)
+def test_porter_stemmer_golden(word, stem):
+    assert PorterStemmer().stem(word) == stem
+
+
+# ---------------------------------------------------------------------------
+# embedding_similarity
+# ---------------------------------------------------------------------------
+
+
+def test_embedding_similarity():
+    rng = np.random.RandomState(0)
+    batch = rng.randn(6, 8).astype(np.float32)
+    normed = batch / np.linalg.norm(batch, axis=1, keepdims=True)
+    expected = normed @ normed.T
+    np.fill_diagonal(expected, 0.0)
+    got = np.asarray(embedding_similarity(batch))
+    np.testing.assert_allclose(got, expected, atol=1e-5)
+
+    got_dot = np.asarray(embedding_similarity(batch, similarity="dot", zero_diagonal=False))
+    np.testing.assert_allclose(got_dot, batch @ batch.T, atol=1e-4)
+
+    got_mean = np.asarray(embedding_similarity(batch, reduction="mean"))
+    np.testing.assert_allclose(got_mean, expected.mean(-1), atol=1e-5)
+
+
+def test_rouge_lsum_union_lcs_differs_from_rougel():
+    # sentence order flipped: whole-text LCS (rougeL) penalizes order,
+    # summary-level union-LCS (rougeLsum) must score it perfectly
+    pred = "The cat sat. The dog barked."
+    target = "The dog barked. The cat sat."
+    scores = rouge_score(pred, target, rouge_keys=("rougeL", "rougeLsum"))
+    assert float(scores["rougeLsum_fmeasure"]) == pytest.approx(1.0)
+    assert float(scores["rougeL_fmeasure"]) < 1.0
+
+
+def test_wer_length_mismatch():
+    with pytest.raises(ValueError, match="must be the same"):
+        wer(["a b", "c d"], ["a b"])
