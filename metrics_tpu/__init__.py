@@ -14,6 +14,7 @@ _logger.setLevel(logging.INFO)
 __version__ = "0.1.0"
 
 from metrics_tpu.core.average import AverageMeter
+from metrics_tpu.core.cat_buffer import CatBuffer
 from metrics_tpu.core.collections import MetricCollection
 from metrics_tpu.core.metric import CompositionalMetric, Metric
 from metrics_tpu.classification import (
@@ -69,6 +70,7 @@ from metrics_tpu.text import BERTScore, BLEUScore, ROUGEScore, WER
 from metrics_tpu.wrappers import BootStrapper, MetricTracker
 
 __all__ = [
+    "CatBuffer",
     "BERTScore",
     "BLEUScore",
     "ROUGEScore",

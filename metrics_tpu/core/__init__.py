@@ -1,2 +1,3 @@
+from metrics_tpu.core.cat_buffer import CatBuffer
 from metrics_tpu.core.collections import MetricCollection
 from metrics_tpu.core.metric import CompositionalMetric, Metric

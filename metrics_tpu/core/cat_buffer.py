@@ -1,0 +1,176 @@
+"""Fixed-capacity "cat"-state ring buffers — TPU-native list states.
+
+The reference accumulates curve/retrieval inputs in *growing python lists*
+(``add_state(default=[], dist_reduce_fx="cat")``, reference ``metric.py:112-176``)
+and concatenates at ``compute()``. Growing shapes are hostile to XLA: every new
+batch count retraces the jitted step, and collectives need static shapes
+(reference pads ad hoc at ``utilities/distributed.py:122-145``).
+
+:class:`CatBuffer` replaces the list with a **pre-allocated
+``[capacity, ...]`` buffer + a fill count**:
+
+- ``append`` is a ``lax.dynamic_update_slice`` — static shapes, O(1) memory,
+  the jitted update step never retraces as data accumulates and the buffer can
+  be donated.
+- cross-device sync is a plain ``lax.all_gather`` of buffers + counts followed
+  by a static-shape scatter compaction (:func:`sync_cat_buffer_in_jit`) — the
+  uneven-per-rank protocol with no host round-trip.
+- ``merge`` (checkpoint resume / ``forward`` accumulation) is a masked scatter
+  at the fill offset, also static-shape.
+
+Opt in per metric via ``metric.with_capacity(n)``: every declared list state
+becomes a ``CatBuffer``; the metric's ``update``/``compute`` code is unchanged
+(``.append`` and ``dim_zero_cat`` dispatch on the type).
+
+Eager appends past capacity raise; inside jit (no exceptions possible) writes
+clamp at the end of the buffer — size ``capacity`` to your eval set.
+"""
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array, lax
+
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+__all__ = ["CatBuffer", "sync_cat_buffer_in_jit"]
+
+
+def _is_traced(x: Any) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class CatBuffer:
+    """A bounded, jit-friendly accumulation buffer for "cat" metric states.
+
+    Attributes:
+        capacity: max number of rows (static).
+        buffer: ``[capacity, *item_shape]`` array, or ``None`` until the first
+            ``append`` fixes the item shape/dtype.
+        count: scalar int32 — number of valid rows.
+    """
+
+    __slots__ = ("capacity", "buffer", "count")
+
+    def __init__(self, capacity: int, buffer: Optional[Array] = None, count: Optional[Array] = None) -> None:
+        if capacity <= 0:
+            raise ValueError(f"CatBuffer capacity must be positive, got {capacity}")
+        self.capacity = int(capacity)
+        self.buffer = buffer
+        self.count = jnp.zeros((), jnp.int32) if count is None else count
+
+    # -- accumulation ---------------------------------------------------
+    def append(self, batch: Array) -> "CatBuffer":
+        """Write a batch of rows at the fill offset (in place; returns self)."""
+        batch = jnp.asarray(batch)
+        if batch.ndim == 0:
+            batch = batch[None]
+        n = batch.shape[0]
+        if self.buffer is None:
+            self.buffer = jnp.zeros((self.capacity,) + batch.shape[1:], batch.dtype)
+        if n > self.capacity:
+            raise MetricsTPUUserError(
+                f"Batch of {n} rows exceeds CatBuffer capacity {self.capacity}."
+            )
+        if not _is_traced(self.count):
+            if int(self.count) + n > self.capacity:
+                raise MetricsTPUUserError(
+                    f"CatBuffer overflow: {int(self.count)} + {n} > capacity {self.capacity}. "
+                    "Construct the metric with a larger `with_capacity(...)`."
+                )
+        start = (self.count,) + (jnp.zeros((), jnp.int32),) * (batch.ndim - 1)
+        self.buffer = lax.dynamic_update_slice(self.buffer, batch.astype(self.buffer.dtype), start)
+        self.count = self.count + jnp.asarray(n, jnp.int32)
+        return self
+
+    # -- reads ----------------------------------------------------------
+    def values(self) -> Array:
+        """The valid rows ``buffer[:count]`` (eager only: dynamic shape)."""
+        if self.buffer is None:
+            return jnp.zeros((0,))
+        if _is_traced(self.count) or _is_traced(self.buffer):
+            raise MetricsTPUUserError(
+                "CatBuffer.values() needs a concrete fill count and is eager-only; "
+                "inside jit use `.buffer` with `.mask()` (padding-aware compute), "
+                "or a Binned* metric for a fully-fused constant-shape pipeline."
+            )
+        return self.buffer[: int(self.count)]
+
+    def mask(self) -> Array:
+        """``[capacity]`` bool validity mask — jit-safe padding awareness."""
+        return jnp.arange(self.capacity) < self.count
+
+    def __len__(self) -> int:
+        return int(self.count)
+
+    # -- functional structure -------------------------------------------
+    def copy(self) -> "CatBuffer":
+        return CatBuffer(self.capacity, self.buffer, self.count)
+
+    def reset(self) -> "CatBuffer":
+        return CatBuffer(self.capacity)
+
+    def merge(self, other: "CatBuffer") -> "CatBuffer":
+        """New CatBuffer = self's rows then other's rows (capacity = self's).
+
+        Static-shape: other's rows scatter at offset ``self.count`` with
+        out-of-bounds rows dropped (eager overflow raises).
+        """
+        if other.buffer is None:
+            return self.copy()
+        if self.buffer is None:
+            base = CatBuffer(self.capacity)
+            base.buffer = jnp.zeros((self.capacity,) + other.buffer.shape[1:], other.buffer.dtype)
+            base.count = jnp.zeros((), jnp.int32)
+            return base.merge(other)
+        if not (_is_traced(self.count) or _is_traced(other.count)):
+            if int(self.count) + int(other.count) > self.capacity:
+                raise MetricsTPUUserError(
+                    f"CatBuffer overflow on merge: {int(self.count)} + {int(other.count)} "
+                    f"> capacity {self.capacity}."
+                )
+        rows = jnp.arange(other.capacity)
+        idx = jnp.where(rows < other.count, self.count + rows, self.capacity)
+        buffer = self.buffer.at[idx].set(other.buffer.astype(self.buffer.dtype), mode="drop")
+        return CatBuffer(self.capacity, buffer, self.count + other.count)
+
+    def __repr__(self) -> str:
+        item = None if self.buffer is None else self.buffer.shape[1:]
+        return f"CatBuffer(capacity={self.capacity}, count={self.count}, item_shape={item})"
+
+
+def _catbuffer_flatten(cb: CatBuffer) -> Tuple[Sequence[Any], int]:
+    return (cb.buffer, cb.count), cb.capacity
+
+
+def _catbuffer_unflatten(capacity: int, children: Sequence[Any]) -> CatBuffer:
+    buffer, count = children
+    return CatBuffer(capacity, buffer, count)
+
+
+jax.tree_util.register_pytree_node(CatBuffer, _catbuffer_flatten, _catbuffer_unflatten)
+
+
+def sync_cat_buffer_in_jit(cb: CatBuffer, axis_name: str) -> CatBuffer:
+    """All-gather a CatBuffer across a mesh axis into one compacted buffer.
+
+    Static-shape replacement for the reference's uneven-shape gather protocol
+    (``utilities/distributed.py:122-145``): gather ``[W, capacity, ...]``
+    buffers + ``[W]`` counts, then scatter each rank's valid rows at its
+    exclusive-cumsum offset into a ``[W*capacity, ...]`` result. One
+    ``all_gather`` collective per state, rides ICI inside the jitted program.
+    """
+    if cb.buffer is None:
+        raise MetricsTPUUserError("Cannot sync an empty CatBuffer (no item shape yet).")
+    bufs = lax.all_gather(cb.buffer, axis_name)  # [W, cap, ...]
+    counts = lax.all_gather(cb.count, axis_name)  # [W]
+    world = bufs.shape[0]
+    new_cap = world * cb.capacity
+    offsets = jnp.cumsum(counts) - counts
+    rows = jnp.arange(cb.capacity)
+    # one combined scatter: row r of rank w lands at offsets[w]+r if valid,
+    # else at new_cap (dropped)
+    idx = jnp.where(rows[None, :] < counts[:, None], offsets[:, None] + rows[None, :], new_cap)
+    out = jnp.zeros((new_cap,) + bufs.shape[2:], cb.buffer.dtype)
+    out = out.at[idx.reshape(-1)].set(bufs.reshape((new_cap,) + bufs.shape[2:]), mode="drop")
+    return CatBuffer(new_cap, out, jnp.sum(counts).astype(jnp.int32))

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.core.cat_buffer import CatBuffer
 from metrics_tpu.parallel.sync import (
     host_sync_state,
     jit_distributed_available,
@@ -45,7 +46,11 @@ _MERGEABLE_FX = ("sum", "cat", "max", "min")
 
 
 def _copy_state_value(v: Any) -> Any:
-    return list(v) if isinstance(v, list) else v
+    if isinstance(v, list):
+        return list(v)
+    if isinstance(v, CatBuffer):
+        return v.copy()
+    return v
 
 
 class Metric:
@@ -124,6 +129,38 @@ class Metric:
         self._persistent[name] = persistent
         self._state[name] = _copy_state_value(default)
 
+    def with_capacity(self, capacity: int) -> "Metric":
+        """Convert every list ("cat") state into a fixed-capacity
+        :class:`~metrics_tpu.core.cat_buffer.CatBuffer` of ``capacity`` rows.
+
+        The TPU-native accumulation mode: the jitted update step keeps a static
+        shape (no retrace as data grows), and cross-device sync is one
+        static-shape ``all_gather`` + scatter compaction instead of the
+        reference's pad-to-max host protocol (``utilities/distributed.py:122-145``).
+        ``update``/``compute`` code is unchanged — ``.append`` and
+        ``dim_zero_cat`` dispatch on the state type. Returns ``self``.
+        """
+        for name, default in self._defaults.items():
+            if isinstance(default, list):
+                if default or (isinstance(self._state.get(name), list) and self._state[name]):
+                    raise MetricsTPUUserError(
+                        "with_capacity() must be called before any update() "
+                        f"(state {name!r} already holds data)."
+                    )
+                self._defaults[name] = CatBuffer(capacity)
+                self._state[name] = CatBuffer(capacity)
+            elif isinstance(default, CatBuffer):
+                # resize, allowed only while empty
+                current = self._state.get(name)
+                if (isinstance(current, CatBuffer) and len(current)) or len(default):
+                    raise MetricsTPUUserError(
+                        "with_capacity() cannot resize a CatBuffer state that "
+                        f"already holds data (state {name!r})."
+                    )
+                self._defaults[name] = CatBuffer(capacity)
+                self._state[name] = CatBuffer(capacity)
+        return self
+
     def __getattr__(self, name: str) -> Any:
         # only called when normal lookup fails
         state = object.__getattribute__(self, "__dict__").get("_state")
@@ -166,8 +203,10 @@ class Metric:
 
         accumulated = {k: _copy_state_value(v) for k, v in self._state.items()}
         update_count_supported = self._can_merge()
-        # fresh state -> batch state
-        self._restore(self._default_state())
+        # fresh state -> batch state; CatBuffer states accumulate the batch in
+        # a plain list so the per-batch work is O(batch), not O(capacity) —
+        # merge_states appends the rows into the fixed buffer afterwards
+        self._restore(self._batch_default_state())
         self.update(*args, **kwargs)
         batch_state = {k: _copy_state_value(v) for k, v in self._state.items()}
 
@@ -362,7 +401,16 @@ class Metric:
         out: Dict[str, Any] = {}
         for name, fx in self._reductions.items():
             a, b = state_a[name], state_b[name]
-            if isinstance(self._defaults[name], list):
+            if isinstance(a, CatBuffer) and isinstance(b, list):
+                merged = a.copy()
+                for arr in b:
+                    merged.append(jnp.asarray(arr))
+                out[name] = merged
+            elif isinstance(a, CatBuffer):
+                out[name] = a.merge(b)
+            elif isinstance(b, CatBuffer):
+                out[name] = list(a) + ([b.values()] if len(b) else [])
+            elif isinstance(self._defaults[name], list):
                 out[name] = list(a) + list(b)
             elif fx == "sum":
                 out[name] = a + b
@@ -386,6 +434,14 @@ class Metric:
 
     def _default_state(self) -> Dict[str, Any]:
         return {k: _copy_state_value(v) for k, v in self._defaults.items()}
+
+    def _batch_default_state(self) -> Dict[str, Any]:
+        """Fresh state for a single eager batch: CatBuffer defaults become
+        plain lists so one ``forward`` costs O(batch) instead of O(capacity)."""
+        return {
+            k: [] if isinstance(v, CatBuffer) else _copy_state_value(v)
+            for k, v in self._defaults.items()
+        }
 
     def _restore(self, state: Dict[str, Any]) -> None:
         for k, v in state.items():
@@ -427,9 +483,16 @@ class Metric:
             if not self._persistent[name]:
                 continue
             v = self._state[name]
-            out[prefix + name] = (
-                [np.asarray(x) for x in v] if isinstance(v, list) else np.asarray(v)
-            )
+            if isinstance(v, CatBuffer):
+                out[prefix + name] = {
+                    "__catbuffer__": v.capacity,
+                    "buffer": None if v.buffer is None else np.asarray(v.buffer),
+                    "count": np.asarray(v.count),
+                }
+            elif isinstance(v, list):
+                out[prefix + name] = [np.asarray(x) for x in v]
+            else:
+                out[prefix + name] = np.asarray(v)
         return out
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
@@ -437,9 +500,34 @@ class Metric:
             key = prefix + name
             if key in state_dict:
                 v = state_dict[key]
-                self._state[name] = (
-                    [jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)
-                )
+                declared = self._defaults[name]
+                if isinstance(v, dict) and "__catbuffer__" in v:
+                    loaded: Any = CatBuffer(
+                        v["__catbuffer__"],
+                        None if v["buffer"] is None else jnp.asarray(v["buffer"]),
+                        jnp.asarray(v["count"]),
+                    )
+                elif isinstance(v, list):
+                    loaded = [jnp.asarray(x) for x in v]
+                else:
+                    loaded = jnp.asarray(v)
+                # normalize the loaded kind to this metric's declared state mode
+                # (a CatBuffer checkpoint may be resumed by a list-state metric
+                # and vice versa)
+                if isinstance(declared, CatBuffer) and isinstance(loaded, list):
+                    cb = CatBuffer(declared.capacity)
+                    for x in loaded:
+                        cb.append(x)
+                    loaded = cb
+                elif isinstance(declared, CatBuffer) and isinstance(loaded, CatBuffer):
+                    # keep this metric's declared capacity, not the checkpoint's
+                    cb = CatBuffer(declared.capacity)
+                    if len(loaded):
+                        cb.append(loaded.values())
+                    loaded = cb
+                elif isinstance(declared, list) and isinstance(loaded, CatBuffer):
+                    loaded = [loaded.values()] if len(loaded) else []
+                self._state[name] = loaded
                 self._update_called = True
 
     def to_device(self, device: Any) -> "Metric":
@@ -480,7 +568,9 @@ class Metric:
         hash_vals = [type(self).__name__]
         for name in self._defaults:
             v = self._state[name]
-            if isinstance(v, list):
+            if isinstance(v, CatBuffer):
+                hash_vals.append(np.asarray(v.values()).tobytes())
+            elif isinstance(v, list):
                 hash_vals.extend(np.asarray(x).tobytes() for x in v)
             else:
                 hash_vals.append(np.asarray(v).tobytes())

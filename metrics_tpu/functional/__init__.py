@@ -20,7 +20,7 @@ from metrics_tpu.functional.classification.precision_recall_curve import precisi
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.functional.classification.calibration_error import calibration_error
 from metrics_tpu.functional.classification.hinge import hinge
-from metrics_tpu.functional.classification.kl_divergence import kl_divergence
+from metrics_tpu.functional.classification.kl_divergence import kl_divergence, kldivergence
 from metrics_tpu.functional.regression.cosine_similarity import cosine_similarity
 from metrics_tpu.functional.regression.explained_variance import explained_variance
 from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error
@@ -30,7 +30,7 @@ from metrics_tpu.functional.regression.mean_absolute_percentage_error import (
 from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error
 from metrics_tpu.functional.regression.mean_squared_log_error import mean_squared_log_error
 from metrics_tpu.functional.regression.pearson import pearson_corrcoef
-from metrics_tpu.functional.regression.r2 import r2_score
+from metrics_tpu.functional.regression.r2 import r2_score, r2score
 from metrics_tpu.functional.regression.spearman import spearman_corrcoef
 from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error import (
     symmetric_mean_absolute_percentage_error,

@@ -41,3 +41,15 @@ def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optiona
     r"""KL divergence :math:`D_{KL}(P||Q) = \sum_x P(x)\log\frac{P(x)}{Q(x)}`."""
     measures, total = _kld_update(p, q, log_prob)
     return _kld_compute(measures, jnp.asarray(total), reduction)
+
+
+def kldivergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    """Deprecated alias of :func:`kl_divergence` (reference
+    ``torchmetrics/functional/classification/kl_divergence.py:114-147``)."""
+    from warnings import warn
+
+    warn(
+        "`functional.kldivergence` was renamed to `functional.kl_divergence` and will be removed.",
+        DeprecationWarning,
+    )
+    return kl_divergence(p, q, log_prob, reduction)

@@ -75,3 +75,20 @@ def r2_score(
     """R² (coefficient of determination), optionally adjusted / multioutput."""
     sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
     return _r2_score_compute(sum_squared_obs, sum_obs, rss, n_obs, adjusted, multioutput)
+
+
+def r2score(
+    preds: Array,
+    target: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Deprecated alias of :func:`r2_score` (reference
+    ``torchmetrics/functional/regression/r2score.py:22-60``)."""
+    from warnings import warn
+
+    warn(
+        "`functional.r2score` was renamed to `functional.r2_score` and will be removed.",
+        DeprecationWarning,
+    )
+    return r2_score(preds, target, adjusted, multioutput)

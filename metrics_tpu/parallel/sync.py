@@ -97,10 +97,14 @@ def sync_in_jit(
     costs exactly one collective — the fused analogue of reference
     ``metric.py:220-223`` (pre-concatenate to reduce the number of gathers).
     """
+    from metrics_tpu.core.cat_buffer import CatBuffer, sync_cat_buffer_in_jit
+
     out: Dict[str, Any] = {}
     for name, value in state.items():
         fx = reductions.get(name)
-        if isinstance(value, (list, tuple)):
+        if isinstance(value, CatBuffer):
+            out[name] = sync_cat_buffer_in_jit(value, axis_name)
+        elif isinstance(value, (list, tuple)):
             if len(value) == 0:
                 out[name] = value
                 continue
@@ -150,6 +154,26 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
 
 def host_sync_leaf(value: Any, fx: ReduceFx) -> Any:
     """Host-path sync of one state leaf across processes (eager)."""
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
+    if isinstance(value, CatBuffer):
+        if not jit_distributed_available():
+            return value.copy()
+        world = jax.process_count()
+        # gather fill counts first so an empty rank fails symmetrically on all
+        # ranks (mirrors the list-state protocol below) instead of poisoning
+        # the merged buffer's shape/dtype with a placeholder
+        counts = np.asarray(_process_allgather(jnp.asarray(len(value), dtype=jnp.int32)))
+        if (counts == 0).any():
+            raise RuntimeError(
+                "Cannot sync a CatBuffer state across processes: at least one process "
+                "has an empty state (no update() before sync()). All processes raised."
+            )
+        pieces = gather_all_arrays(value.values())  # uneven rows handled
+        merged = CatBuffer(world * value.capacity)
+        for p in pieces:
+            merged.append(p)
+        return merged
     if isinstance(value, (list, tuple)):
         vals: List[Array] = (
             [jnp.concatenate([v[None] if v.ndim == 0 else v for v in value], axis=0)]

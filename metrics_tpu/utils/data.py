@@ -16,7 +16,17 @@ METRIC_EPS = 1e-6
 
 
 def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
-    """Concatenate a (possibly list-valued) state along dim 0."""
+    """Concatenate a (possibly list- or CatBuffer-valued) state along dim 0."""
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
+    if isinstance(x, CatBuffer):
+        import jax as _jax
+
+        if x.buffer is None or (
+            not isinstance(x.count, _jax.core.Tracer) and len(x) == 0
+        ):
+            raise ValueError("No samples to concatenate")
+        return x.values()
     x = list(x) if isinstance(x, (list, tuple)) else [x]
     if not x:
         raise ValueError("No samples to concatenate")
@@ -122,8 +132,16 @@ def apply_to_collection(
 
     Analogue of ``utilities/data.py:153-200``.
     """
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
     if isinstance(data, dtype) and (wrong_dtype is None or not isinstance(data, wrong_dtype)):
         return function(data, *args, **kwargs)
+    if isinstance(data, CatBuffer):
+        return CatBuffer(
+            data.capacity,
+            None if data.buffer is None else apply_to_collection(data.buffer, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs),
+            apply_to_collection(data.count, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs),
+        )
     if isinstance(data, Mapping):
         return type(data)(
             {k: apply_to_collection(v, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs) for k, v in data.items()}

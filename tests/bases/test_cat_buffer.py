@@ -1,0 +1,355 @@
+"""CatBuffer — fixed-capacity TPU-native cat-states.
+
+Covers: parity with the list path, jit accumulation without retracing,
+in-jit collective sync over a mesh, merge/pickle/state_dict round trips,
+and overflow policies.
+"""
+import pickle
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from sklearn.metrics import average_precision_score, roc_auc_score
+
+from metrics_tpu import AUROC, AveragePrecision, CatBuffer, PrecisionRecallCurve
+from metrics_tpu.core.cat_buffer import sync_cat_buffer_in_jit
+from metrics_tpu.retrieval import RetrievalMAP
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+NUM_BATCHES = 10
+BATCH_SIZE = 32
+
+rng = np.random.RandomState(7)
+_preds = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+
+
+# ---------------------------------------------------------------------------
+# primitive behavior
+# ---------------------------------------------------------------------------
+
+def test_append_and_values():
+    cb = CatBuffer(8)
+    cb.append(jnp.array([1.0, 2.0]))
+    cb.append(jnp.array([3.0]))
+    assert len(cb) == 3
+    np.testing.assert_array_equal(np.asarray(cb.values()), [1.0, 2.0, 3.0])
+    np.testing.assert_array_equal(np.asarray(cb.mask()), [1, 1, 1, 0, 0, 0, 0, 0])
+
+
+def test_scalar_append_promotes_to_row():
+    cb = CatBuffer(4)
+    cb.append(jnp.asarray(5.0))
+    cb.append(jnp.asarray(6.0))
+    np.testing.assert_array_equal(np.asarray(cb.values()), [5.0, 6.0])
+
+
+def test_eager_overflow_raises():
+    cb = CatBuffer(3)
+    cb.append(jnp.array([1.0, 2.0]))
+    with pytest.raises(MetricsTPUUserError, match="overflow"):
+        cb.append(jnp.array([3.0, 4.0]))
+    with pytest.raises(MetricsTPUUserError, match="exceeds"):
+        CatBuffer(3).append(jnp.zeros(10))
+
+
+def test_merge_parity_and_overflow():
+    a, b = CatBuffer(8), CatBuffer(8)
+    a.append(jnp.array([1.0, 2.0]))
+    b.append(jnp.array([3.0, 4.0, 5.0]))
+    merged = a.merge(b)
+    np.testing.assert_array_equal(np.asarray(merged.values()), [1, 2, 3, 4, 5])
+    big_a, big_b = CatBuffer(4), CatBuffer(4)
+    big_a.append(jnp.zeros(3))
+    big_b.append(jnp.zeros(3))
+    with pytest.raises(MetricsTPUUserError, match="overflow"):
+        big_a.merge(big_b)
+
+
+def test_values_inside_jit_raises():
+    def f(cb):
+        return cb.values()
+
+    cb = CatBuffer(4)
+    cb.append(jnp.array([1.0]))
+    with pytest.raises(MetricsTPUUserError, match="eager-only"):
+        jax.jit(f)(cb)
+
+
+def test_multidim_rows():
+    cb = CatBuffer(6)
+    cb.append(jnp.ones((2, 3)))
+    cb.append(jnp.zeros((1, 3)))
+    assert cb.buffer.shape == (6, 3)
+    assert np.asarray(cb.values()).shape == (3, 3)
+
+
+# ---------------------------------------------------------------------------
+# metric integration
+# ---------------------------------------------------------------------------
+
+def _sk_auroc(p, t):
+    return roc_auc_score(t.reshape(-1), p.reshape(-1))
+
+
+def test_with_capacity_parity_auroc():
+    m_list, m_cb = AUROC(), AUROC().with_capacity(NUM_BATCHES * BATCH_SIZE)
+    for i in range(NUM_BATCHES):
+        m_list.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        m_cb.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    ref = _sk_auroc(_preds, _target)
+    np.testing.assert_allclose(float(m_list.compute()), ref, atol=1e-6)
+    np.testing.assert_allclose(float(m_cb.compute()), ref, atol=1e-6)
+
+
+def test_with_capacity_parity_average_precision():
+    m = AveragePrecision().with_capacity(NUM_BATCHES * BATCH_SIZE)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    ref = average_precision_score(_target.reshape(-1), _preds.reshape(-1))
+    np.testing.assert_allclose(float(m.compute()), ref, atol=1e-6)
+
+
+def test_with_capacity_parity_pr_curve():
+    m_list, m_cb = PrecisionRecallCurve(), PrecisionRecallCurve().with_capacity(512)
+    for i in range(NUM_BATCHES):
+        m_list.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        m_cb.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    for a, b in zip(m_list.compute(), m_cb.compute()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+
+
+def test_with_capacity_after_update_raises():
+    m = AUROC()
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    with pytest.raises(MetricsTPUUserError, match="before any update"):
+        m.with_capacity(128)
+
+
+def test_jit_accumulation_no_retrace():
+    """The whole point: the jitted update step must not retrace as data grows."""
+    m = AUROC().with_capacity(NUM_BATCHES * BATCH_SIZE)
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m.reset()
+    traces = [0]
+
+    def counted(state, p, t):
+        traces[0] += 1
+        return m.pure_update(state, p, t)
+
+    step = jax.jit(counted)
+    state = m.init_state()
+    for i in range(NUM_BATCHES):
+        state = step(state, jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    # trace 1: empty buffer (None leaf) materializes; trace 2: steady state
+    assert traces[0] == 2
+    np.testing.assert_allclose(
+        float(m.pure_compute(state)), _sk_auroc(_preds, _target), atol=1e-6
+    )
+
+
+def test_jit_accumulation_under_scan():
+    """Steady-state CatBuffer states thread through lax.scan (static shapes)."""
+    m = AUROC().with_capacity(NUM_BATCHES * BATCH_SIZE)
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m.reset()
+    # materialize buffers with one traced-shape update so the carry is stable
+    state = jax.jit(m.pure_update)(m.init_state(), jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    state = jax.tree.map(lambda x: jnp.zeros_like(x), state)
+
+    def body(carry, batch):
+        p, t = batch
+        return m.pure_update(carry, p, t), None
+
+    state, _ = jax.lax.scan(body, state, (jnp.asarray(_preds), jnp.asarray(_target)))
+    np.testing.assert_allclose(
+        float(m.pure_compute(state)), _sk_auroc(_preds, _target), atol=1e-6
+    )
+
+
+def test_sharded_sync_collective():
+    """pure_sync over a real mesh axis: all_gather + static-shape compaction."""
+    world = 2
+    mesh = Mesh(np.array(jax.devices()[:world]), ("dp",))
+    m = AUROC().with_capacity(256)
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m.reset()
+    per_rank = NUM_BATCHES // world
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+    def eval_step(p, t):
+        st = m.init_state()
+        for i in range(per_rank):
+            st = m.pure_update(st, p[0, i], t[0, i])
+        return m.pure_sync(st, "dp")
+
+    synced = eval_step(
+        jnp.asarray(_preds.reshape(world, per_rank, BATCH_SIZE)),
+        jnp.asarray(_target.reshape(world, per_rank, BATCH_SIZE)),
+    )
+    assert synced["preds"].capacity == world * 256
+    assert int(synced["preds"].count) == NUM_BATCHES * BATCH_SIZE
+    np.testing.assert_allclose(
+        float(m.pure_compute(synced)), _sk_auroc(_preds, _target), atol=1e-6
+    )
+
+
+def test_sync_uneven_counts():
+    """Ranks with different fill counts compact without padding rows leaking."""
+    world = 2
+    mesh = Mesh(np.array(jax.devices()[:world]), ("dp",))
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P(), check_vma=False)
+    def f(base):
+        cb = CatBuffer(8)
+        cb.append(jnp.arange(3.0) + base[0, 0])
+        # SPMD can't branch per rank, so emulate uneven fills by shrinking
+        # rank 0's count post-append: rank 0 keeps 2 valid rows, rank 1 all 3
+        rank = jax.lax.axis_index("dp")
+        cb.count = jnp.where(rank == 0, jnp.asarray(2, jnp.int32), cb.count)
+        return sync_cat_buffer_in_jit(cb, "dp")
+
+    out = f(jnp.asarray([[10.0], [20.0]]))
+    assert out.capacity == 16
+    assert int(out.count) == 5
+    # rank 1's rows must start at offset 2 (rank 0's count), not at 3, and
+    # rank 0's invalidated third row (12.0) must not leak through
+    np.testing.assert_array_equal(
+        np.asarray(out.values()), [10.0, 11.0, 20.0, 21.0, 22.0]
+    )
+
+
+def test_metric_state_roundtrips():
+    m = AUROC().with_capacity(128)
+    for i in range(3):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    val = float(m.compute())
+    # pickle
+    m2 = pickle.loads(pickle.dumps(m))
+    assert float(m2.compute()) == pytest.approx(val)
+    # state_dict / load_state_dict (non-tensor config like the detected input
+    # mode is not part of the state_dict, mirroring the reference — warm it
+    # with one update, then overwrite the tensor states from the checkpoint)
+    m.persistent(True)
+    sd = m.state_dict()
+    m3 = AUROC().with_capacity(128)
+    m3.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m3.reset()
+    m3.load_state_dict(sd)
+    assert float(m3.compute()) == pytest.approx(val)
+    # merge two halves == all data
+    a = AUROC().with_capacity(NUM_BATCHES * BATCH_SIZE)
+    b = AUROC().with_capacity(NUM_BATCHES * BATCH_SIZE)
+    for i in range(NUM_BATCHES // 2):
+        a.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    for i in range(NUM_BATCHES // 2, NUM_BATCHES):
+        b.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    a.merge_state(b)
+    np.testing.assert_allclose(float(a.compute()), _sk_auroc(_preds, _target), atol=1e-6)
+
+
+def test_forward_batch_value_with_capacity():
+    m = AUROC().with_capacity(NUM_BATCHES * BATCH_SIZE)
+    for i in range(NUM_BATCHES):
+        batch_val = m(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        np.testing.assert_allclose(
+            float(batch_val), _sk_auroc(_preds[i], _target[i]), atol=1e-6
+        )
+    np.testing.assert_allclose(float(m.compute()), _sk_auroc(_preds, _target), atol=1e-6)
+
+
+def test_retrieval_map_with_capacity():
+    idx = rng.randint(0, 10, (NUM_BATCHES, BATCH_SIZE))
+    m = RetrievalMAP().with_capacity(NUM_BATCHES * BATCH_SIZE)
+    m_list = RetrievalMAP()
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]), jnp.asarray(idx[i]))
+        m_list.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]), jnp.asarray(idx[i]))
+    np.testing.assert_allclose(float(m.compute()), float(m_list.compute()), atol=1e-7)
+
+
+def test_compute_without_update_raises():
+    m = AUROC().with_capacity(64)
+    with pytest.raises(ValueError, match="No samples to concatenate"):
+        m.compute()
+
+
+def test_with_capacity_resize_while_empty():
+    m = AUROC().with_capacity(64).with_capacity(4096)
+    assert m._defaults["preds"].capacity == 4096
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    with pytest.raises(MetricsTPUUserError, match="cannot resize"):
+        m.with_capacity(128)
+
+
+def test_checkpoint_across_state_modes():
+    """A list-state checkpoint restores into a CatBuffer metric and back."""
+    m_list = AUROC()
+    for i in range(3):
+        m_list.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    val = float(m_list.compute())
+    m_list.persistent(True)
+    sd = m_list.state_dict()
+
+    m_cb = AUROC().with_capacity(256)
+    m_cb.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m_cb.reset()
+    m_cb.load_state_dict(sd)
+    assert isinstance(m_cb._state["preds"], CatBuffer)
+    assert float(m_cb.compute()) == pytest.approx(val)
+    # forward keeps working after a cross-mode restore
+    m_cb(jnp.asarray(_preds[3]), jnp.asarray(_target[3]))
+
+    m_cb.persistent(True)
+    sd_cb = m_cb.state_dict()
+    m_back = AUROC()
+    m_back.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m_back.reset()
+    m_back.load_state_dict(sd_cb)
+    assert isinstance(m_back._state["preds"], list)
+    ref = _sk_auroc(_preds[:4], _target[:4])
+    np.testing.assert_allclose(float(m_back.compute()), ref, atol=1e-6)
+
+
+def test_load_state_dict_keeps_declared_capacity():
+    m = AUROC().with_capacity(128)
+    for i in range(3):
+        m.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    m.persistent(True)
+    sd = m.state_dict()
+    big = AUROC().with_capacity(4096)
+    big.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    big.reset()
+    big.load_state_dict(sd)
+    assert big._state["preds"].capacity == 4096
+    big.update(jnp.asarray(_preds[3]), jnp.asarray(_target[3]))  # must not overflow
+    np.testing.assert_allclose(
+        float(big.compute()), _sk_auroc(_preds[:4], _target[:4]), atol=1e-6
+    )
+
+
+def test_merge_state_across_modes():
+    a, b = AUROC(), AUROC().with_capacity(64)
+    for i in range(2):
+        a.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    for i in range(2, 4):
+        b.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    a.merge_state(b)  # list-mode absorbing a CatBuffer-mode metric
+    np.testing.assert_allclose(
+        float(a.compute()), _sk_auroc(_preds[:4], _target[:4]), atol=1e-6
+    )
+
+
+def test_reset_restores_empty_capacity():
+    m = AUROC().with_capacity(64)
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m.reset()
+    assert isinstance(m._state["preds"], CatBuffer)
+    assert len(m._state["preds"]) == 0
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    np.testing.assert_allclose(
+        float(m.compute()), _sk_auroc(_preds[0], _target[0]), atol=1e-6
+    )
