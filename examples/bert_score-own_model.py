@@ -7,12 +7,16 @@ into :class:`metrics_tpu.BERTScore` through ``user_forward_fn``.
 
 To run: python examples/bert_score-own_model.py
 """
+import sys
+from pathlib import Path
 from pprint import pprint
 from typing import Dict, List, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
 
 from metrics_tpu import BERTScore
 

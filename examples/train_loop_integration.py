@@ -1,0 +1,106 @@
+"""Metrics inside a jitted training loop — the framework-integration example.
+
+The reference integrates with PyTorch Lightning by virtue of `Metric` being an
+`nn.Module` (reference ``tests/integrations/test_lightning.py``): metrics are
+updated per step and computed/reset at epoch end. The TPU-native analogue:
+metric state is just another pytree threaded through the jitted train step, so
+``update + loss + grads`` trace into ONE XLA program — no framework hook needed.
+
+Run:
+    python examples/train_loop_integration.py
+"""
+import sys
+from functools import partial
+from pathlib import Path
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
+
+from metrics_tpu import Accuracy, AverageMeter, MetricCollection
+
+NUM_CLASSES = 5
+FEATURES = 16
+HIDDEN = 32
+
+
+def init_params(key: jax.Array) -> Dict[str, Any]:
+    k1, k2 = jax.random.split(key)
+    return {
+        "w1": jax.random.normal(k1, (FEATURES, HIDDEN)) * 0.1,
+        "b1": jnp.zeros((HIDDEN,)),
+        "w2": jax.random.normal(k2, (HIDDEN, NUM_CLASSES)) * 0.1,
+        "b2": jnp.zeros((NUM_CLASSES,)),
+    }
+
+
+def forward(params: Dict[str, Any], x: jax.Array) -> jax.Array:
+    h = jax.nn.relu(x @ params["w1"] + params["b1"])
+    return h @ params["w2"] + params["b2"]
+
+
+def make_train_step(metrics: MetricCollection, loss_meter: AverageMeter, optimizer):
+    """One fused XLA program: forward, loss, grads, optimizer, metric update."""
+
+    @jax.jit
+    def train_step(params, opt_state, metric_state, loss_state, x, y):
+        def loss_fn(p):
+            logits = forward(p, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            return loss, logits
+
+        (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+        metric_state = metrics.pure_update(metric_state, jax.nn.softmax(logits), y)
+        loss_state = loss_meter.pure_update(loss_state, loss)
+        return params, opt_state, metric_state, loss_state, loss
+
+    return train_step
+
+
+def run_training(num_epochs: int = 2, steps_per_epoch: int = 8, batch_size: int = 64, seed: int = 0):
+    """Returns per-epoch metric dicts; epoch-end compute + reset semantics."""
+    rng = np.random.RandomState(seed)
+    key = jax.random.PRNGKey(seed)
+    params = init_params(key)
+
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(params)
+
+    metrics = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=NUM_CLASSES),
+            "macro_acc": Accuracy(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+    loss_meter = AverageMeter()
+    train_step = make_train_step(metrics, loss_meter, optimizer)
+
+    # a learnable synthetic task: class = argmax of a fixed random projection
+    proj = rng.randn(FEATURES, NUM_CLASSES).astype(np.float32)
+
+    history = []
+    for _ in range(num_epochs):
+        metric_state = metrics.init_state()   # epoch-start reset
+        loss_state = loss_meter.init_state()
+        for _ in range(steps_per_epoch):
+            x = rng.randn(batch_size, FEATURES).astype(np.float32)
+            y = (x @ proj).argmax(-1)
+            params, opt_state, metric_state, loss_state, _ = train_step(
+                params, opt_state, metric_state, loss_state, jnp.asarray(x), jnp.asarray(y)
+            )
+        epoch_values = {k: float(v) for k, v in metrics.pure_compute(metric_state).items()}
+        epoch_values["loss"] = float(loss_meter.pure_compute(loss_state))
+        history.append(epoch_values)
+    return history
+
+
+if __name__ == "__main__":
+    for i, epoch in enumerate(run_training()):
+        print(f"epoch {i}: " + ", ".join(f"{k}={v:.4f}" for k, v in epoch.items()))

@@ -54,6 +54,4 @@ class PIT(Metric):
     def compute(self) -> Array:
         return self.sum_pit_metric / self.total
 
-    @property
-    def is_differentiable(self) -> bool:
-        return True
+    is_differentiable = True

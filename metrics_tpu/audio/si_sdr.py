@@ -45,6 +45,4 @@ class SI_SDR(Metric):
     def compute(self) -> Array:
         return self.sum_si_sdr / self.total
 
-    @property
-    def is_differentiable(self) -> bool:
-        return True
+    is_differentiable = True

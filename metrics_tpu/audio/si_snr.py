@@ -40,6 +40,4 @@ class SI_SNR(Metric):
     def compute(self) -> Array:
         return self.sum_si_snr / self.total
 
-    @property
-    def is_differentiable(self) -> bool:
-        return True
+    is_differentiable = True

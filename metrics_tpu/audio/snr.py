@@ -45,6 +45,4 @@ class SNR(Metric):
     def compute(self) -> Array:
         return self.sum_snr / self.total
 
-    @property
-    def is_differentiable(self) -> bool:
-        return True
+    is_differentiable = True

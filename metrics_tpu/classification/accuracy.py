@@ -26,6 +26,8 @@ from metrics_tpu.utils.enums import DataType
 class Accuracy(StatScores):
     r"""Accuracy :math:`\frac{1}{N}\sum_i^N 1(y_i = \hat{y}_i)`."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         threshold: float = 0.5,

@@ -15,6 +15,8 @@ from metrics_tpu.utils.data import dim_zero_cat
 class AUC(Metric):
     """Area under any accumulated (x, y) curve via the trapezoidal rule."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         reorder: bool = False,

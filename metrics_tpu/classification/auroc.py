@@ -16,6 +16,8 @@ from metrics_tpu.utils.enums import AverageMethod, DataType
 class AUROC(Metric):
     """Area under the ROC curve, accumulated over batches via cat-states."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: Optional[int] = None,

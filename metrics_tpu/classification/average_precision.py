@@ -18,6 +18,8 @@ from metrics_tpu.utils.data import dim_zero_cat
 class AveragePrecision(Metric):
     """Area under the precision-recall step curve, over accumulated batches."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: Optional[int] = None,

@@ -18,6 +18,8 @@ from metrics_tpu.functional.classification.cohen_kappa import (
 class CohenKappa(Metric):
     r"""Cohen's kappa from an accumulated confusion matrix."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: int,

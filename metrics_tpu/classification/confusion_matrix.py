@@ -19,6 +19,8 @@ from metrics_tpu.functional.classification.confusion_matrix import (
 class ConfusionMatrix(Metric):
     """Confusion matrix with optional 'true'/'pred'/'all' normalization."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: int,

@@ -14,6 +14,8 @@ from metrics_tpu.functional.classification.f_beta import _fbeta_compute
 class FBeta(StatScores):
     r"""F-beta score, weighting recall by ``beta`` (reference ``f_beta.py:29``)."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: Optional[int] = None,
@@ -59,6 +61,8 @@ class FBeta(StatScores):
 
 class F1(FBeta):
     r"""F1 = F-beta with beta=1 (reference ``f_beta.py:181``)."""
+
+    is_differentiable = False
 
     def __init__(
         self,

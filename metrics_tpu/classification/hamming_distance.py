@@ -18,6 +18,8 @@ from metrics_tpu.functional.classification.hamming_distance import (
 class HammingDistance(Metric):
     r"""Average Hamming loss: fraction of wrongly predicted labels."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         threshold: float = 0.5,

@@ -19,6 +19,8 @@ from metrics_tpu.functional.classification.hinge import (
 class Hinge(Metric):
     r"""Mean hinge loss for binary, Crammer-Singer or one-vs-all inputs."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         squared: bool = False,

@@ -14,6 +14,8 @@ from metrics_tpu.functional.classification.iou import _iou_from_confmat
 class IoU(ConfusionMatrix):
     r"""Jaccard index from an accumulated confusion matrix."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: int,

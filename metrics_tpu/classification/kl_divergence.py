@@ -17,6 +17,8 @@ class KLDivergence(Metric):
     r"""KL divergence accumulated over batches; sum states for mean/sum
     reduction, cat-states for per-sample output."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         log_prob: bool = False,

@@ -18,6 +18,8 @@ from metrics_tpu.functional.classification.matthews_corrcoef import (
 class MatthewsCorrcoef(Metric):
     r"""Matthews correlation coefficient from an accumulated confusion matrix."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: int,

@@ -16,6 +16,8 @@ from metrics_tpu.functional.classification.precision_recall import (
 
 
 class _PrecisionRecallBase(StatScores):
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: Optional[int] = None,

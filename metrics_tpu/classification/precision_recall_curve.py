@@ -19,6 +19,8 @@ from metrics_tpu.utils.data import dim_zero_cat
 class PrecisionRecallCurve(Metric):
     """Precision-recall pairs over all distinct thresholds (exact)."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: Optional[int] = None,

@@ -15,6 +15,8 @@ from metrics_tpu.utils.data import dim_zero_cat
 class ROC(Metric):
     """(fpr, tpr, thresholds) over all distinct thresholds."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: Optional[int] = None,

@@ -14,6 +14,8 @@ from metrics_tpu.functional.classification.specificity import _specificity_compu
 class Specificity(StatScores):
     r"""Specificity :math:`\frac{TN}{TN + FP}` (reference ``specificity.py:28``)."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         num_classes: Optional[int] = None,

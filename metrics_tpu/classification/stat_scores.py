@@ -23,6 +23,8 @@ from metrics_tpu.utils.data import dim_zero_cat
 class StatScores(Metric):
     """Computes the number of true/false positives/negatives and support."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         threshold: float = 0.5,

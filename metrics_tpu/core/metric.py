@@ -53,6 +53,19 @@ def _copy_state_value(v: Any) -> Any:
     return v
 
 
+def _fresh_state_value(v: Any) -> Any:
+    """A deep, newly-allocated copy of a state default (see _default_state)."""
+    if isinstance(v, list):
+        return [jnp.array(x, copy=True) for x in v]
+    if isinstance(v, CatBuffer):
+        return CatBuffer(
+            v.capacity,
+            None if v.buffer is None else jnp.array(v.buffer, copy=True),
+            jnp.array(v.count, copy=True),
+        )
+    return jnp.array(v, copy=True)
+
+
 class Metric:
     """Base class for all metrics.
 
@@ -67,6 +80,11 @@ class Metric:
             replacing the built-in host sync — the seam integrations use
             (reference ``metric.py:78``).
     """
+
+    #: Whether the metric value is differentiable w.r.t. its float inputs.
+    #: ``None`` = undeclared (reference ``metric.py:712-715``); subclasses set
+    #: True/False matching the reference's per-class declarations.
+    is_differentiable: Optional[bool] = None
 
     def __init__(
         self,
@@ -433,7 +451,14 @@ class Metric:
         self._restore(self.merge_states(self._state, other))
 
     def _default_state(self) -> Dict[str, Any]:
-        return {k: _copy_state_value(v) for k, v in self._defaults.items()}
+        """Fresh state with every array leaf a *distinct, newly allocated*
+        buffer. jnp constant caching can hand multiple ``add_state`` defaults
+        the SAME underlying buffer (e.g. every ``jnp.zeros(())``), and
+        ``jax.jit(..., donate_argnums=(0,))`` — the recommended hot-loop mode —
+        invalidates donated buffers, which would kill the aliased defaults and
+        sibling states. Copying here (init/reset only, not the hot path) keeps
+        donation safe."""
+        return {k: _fresh_state_value(v) for k, v in self._defaults.items()}
 
     def _batch_default_state(self) -> Dict[str, Any]:
         """Fresh state for a single eager batch: CatBuffer defaults become

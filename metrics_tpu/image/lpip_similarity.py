@@ -33,6 +33,8 @@ class LPIPS(Metric):
             (replaces the built-in tower, e.g. one with loaded weights).
     """
 
+    is_differentiable = True
+
     def __init__(
         self,
         net_type: str = "alex",

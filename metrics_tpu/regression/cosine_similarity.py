@@ -15,6 +15,8 @@ from metrics_tpu.utils.data import dim_zero_cat
 class CosineSimilarity(Metric):
     r"""Cosine similarity over accumulated rows (cat-states)."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         reduction: str = "sum",

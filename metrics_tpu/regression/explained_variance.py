@@ -15,6 +15,8 @@ from metrics_tpu.functional.regression.explained_variance import (
 class ExplainedVariance(Metric):
     r"""Explained variance via streaming moment states."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         multioutput: str = "uniform_average",

@@ -15,6 +15,8 @@ from metrics_tpu.functional.regression.mean_absolute_percentage_error import (
 class MeanAbsolutePercentageError(Metric):
     r"""MAPE accumulated over batches."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         compute_on_step: bool = True,

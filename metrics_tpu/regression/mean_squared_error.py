@@ -15,6 +15,8 @@ from metrics_tpu.functional.regression.mean_squared_error import (
 class MeanSquaredError(Metric):
     r"""MSE (or RMSE with ``squared=False``), accumulated over batches."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         squared: bool = True,

@@ -15,6 +15,8 @@ from metrics_tpu.functional.regression.mean_squared_log_error import (
 class MeanSquaredLogError(Metric):
     r"""MSLE accumulated over batches."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         compute_on_step: bool = True,

@@ -52,6 +52,8 @@ def _final_aggregation(
 class PearsonCorrcoef(Metric):
     r"""Pearson correlation via mergeable running moments."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         compute_on_step: bool = True,

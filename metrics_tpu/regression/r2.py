@@ -12,6 +12,8 @@ from metrics_tpu.functional.regression.r2 import _r2_score_compute, _r2_score_up
 class R2Score(Metric):
     r"""R² with optional adjustment and multioutput aggregation."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         num_outputs: int = 1,

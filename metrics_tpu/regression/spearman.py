@@ -16,6 +16,8 @@ from metrics_tpu.utils.prints import rank_zero_warn
 class SpearmanCorrcoef(Metric):
     r"""Spearman rank correlation over accumulated samples (cat-states)."""
 
+    is_differentiable = False
+
     def __init__(
         self,
         compute_on_step: bool = True,

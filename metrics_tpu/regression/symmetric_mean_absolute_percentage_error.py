@@ -15,6 +15,8 @@ from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error 
 class SymmetricMeanAbsolutePercentageError(Metric):
     r"""SMAPE accumulated over batches."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         compute_on_step: bool = True,

@@ -15,6 +15,8 @@ from metrics_tpu.functional.regression.tweedie_deviance import (
 class TweedieDevianceScore(Metric):
     r"""Tweedie deviance for a given power, accumulated over batches."""
 
+    is_differentiable = True
+
     def __init__(
         self,
         power: float = 0.0,

@@ -43,6 +43,4 @@ class WER(Metric):
     def compute(self) -> Array:
         return _wer_compute(self.errors, self.total)
 
-    @property
-    def is_differentiable(self) -> bool:
-        return False
+    is_differentiable = False

@@ -1,0 +1,65 @@
+"""State-buffer donation: `jax.jit(metric.pure_update, donate_argnums=(0,))`
+is the recommended hot-loop mode (accumulators update in place in HBM).
+
+Regression guard: jnp's constant cache can alias multiple `add_state` defaults
+to the SAME buffer (every `jnp.zeros(())` is one object), and donating an
+aliased pytree invalidates every alias — including the metric's own defaults.
+`_default_state` must therefore hand out distinct fresh buffers.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import Accuracy, AUROC, MetricCollection, StatScores
+
+rng = np.random.RandomState(5)
+_preds = rng.rand(6, 32, 10).astype(np.float32)
+_target = rng.randint(0, 10, (6, 32))
+
+
+def test_default_state_leaves_are_distinct_buffers():
+    mc = MetricCollection(
+        {"acc": Accuracy(num_classes=10), "stats": StatScores(reduce="macro", num_classes=10)}
+    )
+    seen = set()
+    for sub in mc.init_state().values():
+        for v in sub.values():
+            assert id(v) not in seen, "aliased default buffers break donation"
+            seen.add(id(v))
+
+
+def test_donated_update_loop_and_reset():
+    mc = MetricCollection(
+        {"acc": Accuracy(num_classes=10), "stats": StatScores(reduce="macro", num_classes=10)}
+    )
+    step = jax.jit(mc.pure_update, donate_argnums=(0,))
+    state = mc.init_state()
+    for i in range(6):
+        state = step(state, jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    vals = mc.pure_compute(state)
+    acc = float(np.asarray(vals["acc"]))
+    assert np.isfinite(acc)
+    expected = (np.argmax(_preds, -1) == _target).mean()
+    np.testing.assert_allclose(acc, expected, atol=1e-6)
+    # defaults survive donation: a fresh state starts clean and works again
+    state2 = mc.init_state()
+    state2 = step(state2, jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    acc2 = float(np.asarray(mc.pure_compute(state2)["acc"]))
+    np.testing.assert_allclose(acc2, (np.argmax(_preds[0], -1) == _target[0]).mean(), atol=1e-6)
+
+
+def test_donated_catbuffer_loop():
+    m = AUROC().with_capacity(512)
+    p = rng.rand(4, 32).astype(np.float32)
+    t = rng.randint(0, 2, (4, 32))
+    m.update(jnp.asarray(p[0]), jnp.asarray(t[0]))
+    m.reset()
+    step = jax.jit(m.pure_update, donate_argnums=(0,))
+    state = jax.jit(m.pure_update)(m.init_state(), jnp.asarray(p[0]), jnp.asarray(t[0]))
+    for i in range(1, 4):
+        state = step(state, jnp.asarray(p[i]), jnp.asarray(t[i]))
+    from sklearn.metrics import roc_auc_score
+
+    np.testing.assert_allclose(
+        float(m.pure_compute(state)), roc_auc_score(t.reshape(-1), p.reshape(-1)), atol=1e-6
+    )

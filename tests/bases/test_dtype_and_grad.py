@@ -1,0 +1,131 @@
+"""Precision (bf16/f16) and differentiability axes across the metric matrix.
+
+TPU analogue of the reference's ``run_precision_test_cpu/_gpu`` and
+``run_differentiability_test`` + ``torch.autograd.gradcheck``
+(`tests/helpers/testers.py:431-509`): bf16 is the TPU-native half type; the
+declared ``is_differentiable`` flag is checked semantically (nonzero finite
+grad matching finite differences for True, identically-zero grad for False).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+from metrics_tpu import functional as F
+from tests.helpers.testers import MetricTester
+
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+
+rng = np.random.RandomState(11)
+
+_float_preds = rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_float_target = rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_pos_preds = np.abs(_float_preds) + 0.1
+_pos_target = np.abs(_float_target) + 0.1
+_prob_preds = rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32)
+_class_target = rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_bin_preds = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_bin_target = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+_2d_preds = rng.randn(NUM_BATCHES, BATCH_SIZE, 8).astype(np.float32)
+_2d_target = rng.randn(NUM_BATCHES, BATCH_SIZE, 8).astype(np.float32)
+_probdist = rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32) + 0.05
+_probdist /= _probdist.sum(-1, keepdims=True)
+_probdist2 = rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32) + 0.05
+_probdist2 /= _probdist2.sum(-1, keepdims=True)
+
+# (id, metric_class, functional, preds, target, metric_args)
+DIFFERENTIABLE_CASES = [
+    ("mse", M.MeanSquaredError, F.mean_squared_error, _float_preds, _float_target, {}),
+    ("mae", M.MeanAbsoluteError, F.mean_absolute_error, _float_preds, _float_target, {}),
+    ("msle", M.MeanSquaredLogError, F.mean_squared_log_error, _pos_preds, _pos_target, {}),
+    ("mape", M.MeanAbsolutePercentageError, F.mean_absolute_percentage_error, _pos_preds, _pos_target, {}),
+    ("smape", M.SymmetricMeanAbsolutePercentageError, F.symmetric_mean_absolute_percentage_error, _pos_preds, _pos_target, {}),
+    ("r2", M.R2Score, F.r2_score, _float_preds, _float_target, {}),
+    ("pearson", M.PearsonCorrcoef, F.pearson_corrcoef, _float_preds, _float_target, {}),
+    ("explained_variance", M.ExplainedVariance, F.explained_variance, _float_preds, _float_target, {}),
+    ("tweedie", M.TweedieDevianceScore, F.tweedie_deviance_score, _pos_preds, _pos_target, {}),
+    ("cosine", M.CosineSimilarity, F.cosine_similarity, _2d_preds, _2d_target, {}),
+    ("snr", M.SNR, F.snr, _float_preds, _float_target, {}),
+    ("si_snr", M.SI_SNR, F.si_snr, _float_preds, _float_target, {}),
+    ("si_sdr", M.SI_SDR, F.si_sdr, _float_preds, _float_target, {}),
+    ("kl", M.KLDivergence, F.kl_divergence, _probdist, _probdist2, {}),
+]
+
+NON_DIFFERENTIABLE_CASES = [
+    ("accuracy", M.Accuracy, None, _prob_preds, _class_target, {"num_classes": NUM_CLASSES}),
+    ("auroc", M.AUROC, F.auroc, _bin_preds, _bin_target, {}),
+    ("spearman", M.SpearmanCorrcoef, F.spearman_corrcoef, _float_preds, _float_target, {}),
+    ("average_precision", M.AveragePrecision, F.average_precision, _bin_preds, _bin_target, {}),
+]
+
+PRECISION_CASES = DIFFERENTIABLE_CASES + [
+    ("accuracy", M.Accuracy, None, _prob_preds, _class_target, {"num_classes": NUM_CLASSES}),
+    ("auroc", M.AUROC, None, _bin_preds, _bin_target, {}),
+    ("confmat", M.ConfusionMatrix, None, _prob_preds, _class_target, {"num_classes": NUM_CLASSES}),
+]
+
+
+class TestDtypeAndGrad(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize(
+        "name,metric_class,functional,preds,target,args",
+        DIFFERENTIABLE_CASES,
+        ids=[c[0] for c in DIFFERENTIABLE_CASES],
+    )
+    def test_differentiable(self, name, metric_class, functional, preds, target, args):
+        assert metric_class.is_differentiable is True
+        self.run_differentiability_test(preds, target, metric_class, functional, args)
+
+    @pytest.mark.parametrize(
+        "name,metric_class,functional,preds,target,args",
+        NON_DIFFERENTIABLE_CASES,
+        ids=[c[0] for c in NON_DIFFERENTIABLE_CASES],
+    )
+    def test_non_differentiable_zero_grad(self, name, metric_class, functional, preds, target, args):
+        assert metric_class.is_differentiable is False
+        # functional=None exercises the class-based pure_update/pure_compute fallback
+        self.run_differentiability_test(preds, target, metric_class, functional, args)
+
+    @pytest.mark.parametrize(
+        "name,metric_class,functional,preds,target,args",
+        PRECISION_CASES,
+        ids=[c[0] for c in PRECISION_CASES],
+    )
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16], ids=["bf16", "f16"])
+    def test_half_precision(self, name, metric_class, functional, preds, target, args, dtype):
+        if name == "confmat":
+            # counts are exact integers, but half-precision rounding of the
+            # probabilities legitimately flips a few argmax ties — allow a
+            # handful of reassigned samples rather than value tolerance
+            atol = 4.0
+        elif name in ("mse", "msle", "tweedie", "r2", "explained_variance"):
+            atol = 0.05
+        else:
+            atol = 0.02
+        self.run_precision_test(
+            preds, target, metric_class, functional, args, dtype=dtype, atol=atol
+        )
+
+
+def test_is_differentiable_declared_everywhere_reference_does():
+    """Spot-check flag parity with the reference's per-class declarations."""
+    assert M.StatScores.is_differentiable is False
+    assert M.Precision.is_differentiable is False
+    assert M.Recall.is_differentiable is False
+    assert M.FBeta.is_differentiable is False
+    assert M.F1.is_differentiable is False
+    assert M.Specificity.is_differentiable is False
+    assert M.HammingDistance.is_differentiable is False
+    assert M.ConfusionMatrix.is_differentiable is False
+    assert M.IoU.is_differentiable is False
+    assert M.CohenKappa.is_differentiable is False
+    assert M.MatthewsCorrcoef.is_differentiable is False
+    assert M.ROC.is_differentiable is False
+    assert M.PrecisionRecallCurve.is_differentiable is False
+    assert M.AUC.is_differentiable is False
+    assert M.Hinge.is_differentiable is True
+    assert M.LPIPS.is_differentiable is True
+    assert M.Metric.is_differentiable is None

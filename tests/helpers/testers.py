@@ -185,6 +185,120 @@ class MetricTester:
                              **{k: jnp.asarray(v[i]) for k, v in kwargs_update.items()})
         metric.pure_compute(state)  # must not raise
 
+    def run_precision_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_class: type,
+        metric_functional: Optional[Callable] = None,
+        metric_args: Optional[dict] = None,
+        dtype=jnp.bfloat16,
+        atol: float = 1e-2,
+        **kwargs_update: Any,
+    ) -> None:
+        """Half-precision axis (reference ``run_precision_test_cpu/_gpu``,
+        `testers.py:431-477`): the metric must accept bf16/f16 float inputs and
+        produce a finite value close to the float32 result. bf16 is the TPU-
+        native half type (MXU accumulates in f32), so it is the default here.
+        """
+        metric_args = metric_args or {}
+
+        def cast(x: np.ndarray):
+            arr = jnp.asarray(x)
+            return arr.astype(dtype) if jnp.issubdtype(arr.dtype, jnp.floating) else arr
+
+        m_full = metric_class(**metric_args)
+        m_half = metric_class(**metric_args)
+        for i in range(2):
+            m_full.update(jnp.asarray(preds[i]), jnp.asarray(target[i]),
+                          **{k: jnp.asarray(v[i]) for k, v in kwargs_update.items()})
+            m_half.update(cast(preds[i]), cast(target[i]),
+                          **{k: cast(v[i]) for k, v in kwargs_update.items()})
+        full = np.asarray(m_full.compute(), dtype=np.float64)
+        half = np.asarray(jnp.asarray(m_half.compute(), dtype=jnp.float32), dtype=np.float64)
+        assert np.all(np.isfinite(half)), "half-precision compute produced non-finite values"
+        np.testing.assert_allclose(half, full, atol=atol, rtol=5e-2)
+
+        if metric_functional is not None:
+            f_half = metric_functional(cast(preds[0]), cast(target[0]), **metric_args)
+            assert np.all(np.isfinite(np.asarray(jnp.asarray(f_half, dtype=jnp.float32)))), (
+                "half-precision functional produced non-finite values"
+            )
+
+    def run_differentiability_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_class: type,
+        metric_functional: Optional[Callable] = None,
+        metric_args: Optional[dict] = None,
+    ) -> None:
+        """Differentiability axis (reference ``run_differentiability_test`` +
+        ``torch.autograd.gradcheck``, `testers.py:479-509`).
+
+        JAX computes a gradient for any float function, so the declared
+        ``is_differentiable`` flag is checked *semantically*:
+
+        - ``True``  → ``jax.grad`` w.r.t. preds is finite, somewhere nonzero,
+          and matches a central finite difference along a random direction
+          (the gradcheck analogue, run in x64).
+        - ``False`` → the metric is piecewise constant in preds (argmax/
+          threshold based): the gradient is identically zero.
+        """
+        metric_args = metric_args or {}
+        metric = metric_class(**metric_args)
+        p0 = np.asarray(preds[0])
+        if not np.issubdtype(p0.dtype, np.floating) or metric.is_differentiable is None:
+            return
+        t0 = jnp.asarray(target[0])
+
+        if metric_functional is not None:
+            fn = metric_functional
+        else:
+            # class-based fallback: warm the eager input-mode detection once so
+            # the pure path traces with static config under jax.grad
+            warm = metric_class(**metric_args)
+            warm.update(jnp.asarray(p0), t0)
+            warm.reset()
+
+            def fn(p, t, **kw):
+                return warm.pure_compute(warm.pure_update(warm.init_state(), p, t))
+
+        def scalar_fn(p):
+            out = fn(p, t0, **metric_args)
+            leaves = jax.tree_util.tree_leaves(out)
+            return sum(jnp.sum(leaf) for leaf in leaves if jnp.issubdtype(leaf.dtype, jnp.floating))
+
+        grad = jax.grad(scalar_fn)(jnp.asarray(p0))
+        assert np.all(np.isfinite(np.asarray(grad))), "gradient has non-finite entries"
+
+        if metric.is_differentiable:
+            assert np.any(np.asarray(grad) != 0), (
+                f"{metric_class.__name__} declares is_differentiable=True but "
+                "grad w.r.t. preds is identically zero"
+            )
+            # gradcheck analogue: directional derivative vs central difference.
+            # x64 is enabled for the probe; eps balances truncation error
+            # against round-off for metrics that compute internally in f32
+            # (a float64 input does not force every intermediate to f64).
+            rng_dir = np.random.RandomState(3)
+            direction = rng_dir.randn(*p0.shape)
+            direction /= np.linalg.norm(direction) + 1e-12
+            eps = 1e-4
+            with jax.enable_x64():
+                p64 = np.asarray(p0, dtype=np.float64)
+                f_plus = float(scalar_fn(jnp.asarray(p64 + eps * direction)))
+                f_minus = float(scalar_fn(jnp.asarray(p64 - eps * direction)))
+                grad64 = jax.grad(scalar_fn)(jnp.asarray(p64))
+            fd = (f_plus - f_minus) / (2 * eps)
+            analytic = float(np.sum(np.asarray(grad64, dtype=np.float64) * direction))
+            np.testing.assert_allclose(analytic, fd, rtol=2e-2, atol=1e-4)
+        else:
+            assert not np.any(np.asarray(grad) != 0), (
+                f"{metric_class.__name__} declares is_differentiable=False but "
+                "has a nonzero gradient w.r.t. preds"
+            )
+
     def run_sharded_metric_test(
         self,
         preds: np.ndarray,

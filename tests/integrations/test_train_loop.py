@@ -1,0 +1,61 @@
+"""Training-loop integration — the analogue of the reference's Lightning
+integration tests (``tests/integrations/test_lightning.py``): metrics update
+every step inside the jitted program, compute at epoch end, reset between
+epochs, and the logged values track reality (loss falls, accuracy rises on a
+learnable task)."""
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2] / "examples"))
+
+from train_loop_integration import run_training  # noqa: E402
+
+from metrics_tpu import Accuracy, AverageMeter, MetricCollection  # noqa: E402
+
+
+def test_metrics_improve_over_training():
+    history = run_training(num_epochs=3, steps_per_epoch=10, batch_size=64)
+    assert len(history) == 3
+    # the task is learnable: accuracy must rise materially and loss must fall
+    assert history[-1]["acc"] > history[0]["acc"] + 0.05
+    assert history[-1]["loss"] < history[0]["loss"]
+    # macro over balanced random classes tracks micro closely
+    assert abs(history[-1]["acc"] - history[-1]["macro_acc"]) < 0.1
+
+
+def test_epoch_reset_isolates_epochs():
+    """Epoch N's computed value must only reflect epoch N's batches."""
+    metrics = MetricCollection({"acc": Accuracy(num_classes=3)})
+
+    # epoch 1: all predictions wrong -> acc 0
+    state = metrics.init_state()
+    preds = jnp.asarray(np.eye(3)[np.zeros(30, dtype=int)].astype(np.float32))
+    target = jnp.asarray(np.ones(30, dtype=int))
+    state = metrics.pure_update(state, preds, target)
+    assert float(metrics.pure_compute(state)["acc"]) == 0.0
+
+    # epoch 2: fresh state, all correct -> acc 1 (no leakage from epoch 1)
+    state = metrics.init_state()
+    target2 = jnp.asarray(np.zeros(30, dtype=int))
+    state = metrics.pure_update(state, preds, target2)
+    assert float(metrics.pure_compute(state)["acc"]) == 1.0
+
+
+def test_stateful_api_in_eager_loop():
+    """The torchmetrics-style stateful surface works in an eager train loop."""
+    acc = Accuracy(num_classes=3)
+    meter = AverageMeter()
+    rng = np.random.RandomState(1)
+    for step in range(5):
+        preds = jnp.asarray(rng.rand(16, 3).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 3, (16,)))
+        batch_acc = acc(preds, target)        # per-step value
+        meter.update(jnp.asarray(float(step)), weight=jnp.asarray(1.0))
+        assert 0.0 <= float(batch_acc) <= 1.0
+    assert 0.0 <= float(acc.compute()) <= 1.0
+    assert float(meter.compute()) == 2.0      # mean of 0..4
+    acc.reset()
+    assert acc._update_called is False
