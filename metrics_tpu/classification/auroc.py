@@ -7,8 +7,10 @@ from typing import Any, Callable, Optional
 
 from jax import Array
 
+from metrics_tpu.core.cat_buffer import CatBuffer
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
+from metrics_tpu.ops.ranking import masked_binary_auroc
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import AverageMethod, DataType
 
@@ -64,6 +66,24 @@ class AUROC(Metric):
         self.mode = mode
 
     def compute(self) -> Array:
+        # Binary CatBuffer mode: exact AUROC via tie-averaged Mann-Whitney
+        # ranks — every intermediate keeps the buffer's static shape, so
+        # update + collective sync + compute fuse into ONE jitted program
+        # (the curve path needs data-dependent unique-threshold sizes and is
+        # eager-only). Identical value incl. tie handling, except the
+        # degenerate single-class case: the curve path raises eagerly, this
+        # path (which cannot raise under jit) returns the uninformative 0.5.
+        if (
+            isinstance(self._state["preds"], CatBuffer)
+            and self.mode == DataType.BINARY
+            and self.max_fpr is None
+            and self.pos_label in (None, 1)
+        ):
+            preds_cb: CatBuffer = self._state["preds"]
+            target_cb: CatBuffer = self._state["target"]
+            if preds_cb.buffer is None:
+                raise ValueError("No samples to concatenate")
+            return masked_binary_auroc(preds_cb.buffer, target_cb.buffer, preds_cb.mask())
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _auroc_compute(

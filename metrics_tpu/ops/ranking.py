@@ -1,0 +1,87 @@
+"""Static-shape, padding-aware ranking statistics.
+
+The reference computes AUROC by building an explicit ROC curve over unique
+thresholds (``functional/classification/precision_recall_curve.py:23-61`` →
+``roc.py`` → trapezoid), whose intermediate sizes depend on the data — fine
+eagerly, impossible under XLA's static shapes.
+
+:func:`masked_binary_auroc` instead uses the Mann–Whitney U statistic with
+tie-averaged ranks:
+
+    AUROC = (Σ ranks(positives) − P(P+1)/2) / (P·N)
+
+which is *exactly* the trapezoidal ROC area including tie handling, and every
+intermediate has the input's static shape. With the ``mask`` argument, padded
+rows (e.g. the unfilled tail of a
+:class:`~metrics_tpu.core.cat_buffer.CatBuffer`) are excluded without any
+dynamic slicing — so a CatBuffer-mode AUROC's full
+``update → all_gather sync → compute`` pipeline traces into ONE jitted XLA
+program (the fused-collection design goal, `BASELINE.md` config 2).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+__all__ = ["masked_binary_auroc", "tie_averaged_ranks"]
+
+
+def tie_averaged_ranks(values: Array, valid: Array) -> Array:
+    """1-based tie-averaged ranks of ``values`` among rows where ``valid``.
+
+    Invalid rows receive arbitrary (unused) rank values; callers must weight
+    them out. All shapes static; one sort + two segment sums.
+    """
+    n = values.shape[0]
+    # lexicographic sort (valid, value): invalid rows first, then ascending
+    # values — no sentinel value, so valid -inf / finfo.min scores stay exact
+    order = jnp.lexsort((values, valid.astype(jnp.int32)))
+    v_sorted = values[order]
+    valid_sorted = valid[order]
+    n_invalid = jnp.sum(~valid)
+    # position among VALID rows only (invalid occupy the first slots)
+    pos = jnp.arange(1, n + 1) - n_invalid
+    pos = pos.astype(values.dtype)
+    w = valid_sorted.astype(values.dtype)
+    # tie groups along the sorted order; a validity change always starts a new
+    # group so equal values never tie across the valid/invalid boundary
+    first = jnp.concatenate(
+        [
+            jnp.ones((1,), bool),
+            (v_sorted[1:] != v_sorted[:-1]) | (valid_sorted[1:] != valid_sorted[:-1]),
+        ]
+    )
+    gid = jnp.cumsum(first) - 1
+    sum_pos = jax.ops.segment_sum(pos * w, gid, num_segments=n)
+    cnt = jax.ops.segment_sum(w, gid, num_segments=n)
+    rank_sorted = (sum_pos / jnp.maximum(cnt, 1.0))[gid]
+    # scatter back to original row order
+    ranks = jnp.zeros_like(rank_sorted).at[order].set(rank_sorted)
+    return ranks
+
+
+def masked_binary_auroc(preds: Array, target: Array, mask: Optional[Array] = None) -> Array:
+    """Exact binary AUROC over the rows where ``mask`` — fully jittable.
+
+    Args:
+        preds: ``[N]`` scores.
+        target: ``[N]`` binary labels (0/1).
+        mask: ``[N]`` bool validity; ``None`` = all valid.
+
+    Returns 0.5 when either class is absent among valid rows (degenerate
+    curve), matching the convention of an uninformative classifier.
+    """
+    preds = jnp.asarray(preds, jnp.float32).reshape(-1)
+    target = jnp.asarray(target).reshape(-1).astype(jnp.float32)
+    valid = jnp.ones(preds.shape, bool) if mask is None else jnp.asarray(mask, bool).reshape(-1)
+
+    ranks = tie_averaged_ranks(preds, valid)
+    w = valid.astype(jnp.float32)
+    pos = target * w
+    num_pos = jnp.sum(pos)
+    num_neg = jnp.sum(w) - num_pos
+    sum_ranks_pos = jnp.sum(ranks * pos)
+    u = sum_ranks_pos - num_pos * (num_pos + 1.0) / 2.0
+    denom = num_pos * num_neg
+    return jnp.where(denom > 0, u / jnp.maximum(denom, 1.0), jnp.asarray(0.5, jnp.float32))

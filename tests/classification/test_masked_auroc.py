@@ -1,0 +1,145 @@
+"""masked_binary_auroc — static-shape Mann-Whitney AUROC with tie handling.
+
+Parity vs sklearn's trapezoidal roc_auc_score (exact, including ties), plus
+the design goal it unlocks: a CatBuffer AUROC whose update + collective sync
++ compute trace into ONE jitted XLA program.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from sklearn.metrics import roc_auc_score
+
+from metrics_tpu import AUROC
+from metrics_tpu.ops.ranking import masked_binary_auroc, tie_averaged_ranks
+
+rng = np.random.RandomState(21)
+
+
+def test_tie_averaged_ranks_matches_scipy():
+    from scipy.stats import rankdata
+
+    vals = np.array([3.0, 1.0, 3.0, 2.0, 3.0, 1.0], np.float32)
+    got = np.asarray(tie_averaged_ranks(jnp.asarray(vals), jnp.ones(6, bool)))
+    np.testing.assert_allclose(got, rankdata(vals), atol=1e-6)
+
+
+def test_ranks_with_mask_ignore_padding():
+    vals = np.array([0.5, 0.2, 9.9, 0.8, 9.9], np.float32)  # rows 2,4 padded
+    valid = np.array([True, True, False, True, False])
+    got = np.asarray(tie_averaged_ranks(jnp.asarray(vals), jnp.asarray(valid)))
+    np.testing.assert_allclose(got[valid], [2.0, 1.0, 3.0], atol=1e-6)
+
+
+@pytest.mark.parametrize("n", [16, 321, 2048])
+def test_auroc_parity_continuous(n):
+    p = rng.rand(n).astype(np.float32)
+    t = rng.randint(0, 2, n)
+    got = float(masked_binary_auroc(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(got, roc_auc_score(t, p), atol=1e-6)
+
+
+def test_auroc_parity_heavy_ties():
+    # quantized scores: many tied groups — the case where naive trapz breaks
+    p = (rng.randint(0, 5, 400) / 4.0).astype(np.float32)
+    t = rng.randint(0, 2, 400)
+    got = float(masked_binary_auroc(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(got, roc_auc_score(t, p), atol=1e-6)
+
+
+def test_auroc_mask_equals_slice():
+    p = rng.rand(256).astype(np.float32)
+    t = rng.randint(0, 2, 256)
+    mask = np.arange(256) < 100
+    got = float(masked_binary_auroc(jnp.asarray(p), jnp.asarray(t), jnp.asarray(mask)))
+    np.testing.assert_allclose(got, roc_auc_score(t[:100], p[:100]), atol=1e-6)
+
+
+def test_ranks_with_valid_neg_inf_scores():
+    """A valid -inf score must rank below every other valid row, not collide
+    with the padding (regression: sentinel-value sorting)."""
+    vals = np.array([-np.inf, 0.5, 0.2, 1.0], np.float32)
+    valid = np.array([True, True, False, True])
+    got = np.asarray(tie_averaged_ranks(jnp.asarray(vals), jnp.asarray(valid)))
+    np.testing.assert_allclose(got[valid], [1.0, 2.0, 3.0], atol=1e-6)
+    # sklearn rejects -inf inputs; by hand: positives {0.9, 0.1} vs negatives
+    # {-inf, 0.5} win 3 of 4 pairs -> AUROC 0.75
+    p = np.array([-np.inf, 0.9, 0.1, 0.5], np.float32)
+    t = np.array([0, 1, 1, 0])
+    got_auc = float(masked_binary_auroc(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(got_auc, 0.75, atol=1e-6)
+
+
+def test_auroc_pos_label_zero_not_fast_pathed():
+    """pos_label=0 must keep curve-path semantics (class 0 scored positive)."""
+    p = rng.rand(6, 32).astype(np.float32)
+    t = rng.randint(0, 2, (6, 32))
+    m_list, m_cb = AUROC(pos_label=0), AUROC(pos_label=0).with_capacity(256)
+    for i in range(6):
+        m_list.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+        m_cb.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    np.testing.assert_allclose(float(m_cb.compute()), float(m_list.compute()), atol=1e-6)
+
+
+def test_auroc_degenerate_single_class():
+    p = rng.rand(32).astype(np.float32)
+    assert float(masked_binary_auroc(jnp.asarray(p), jnp.zeros(32))) == 0.5
+    assert float(masked_binary_auroc(jnp.asarray(p), jnp.ones(32))) == 0.5
+
+
+def test_catbuffer_auroc_compute_matches_list_mode():
+    p = rng.rand(10, 32).astype(np.float32)
+    t = rng.randint(0, 2, (10, 32))
+    m_list, m_cb = AUROC(), AUROC().with_capacity(512)
+    for i in range(10):
+        m_list.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+        m_cb.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    np.testing.assert_allclose(float(m_cb.compute()), float(m_list.compute()), atol=1e-6)
+
+
+def test_fully_fused_sharded_pipeline():
+    """update + all_gather sync + compute in ONE traced program, multi-device."""
+    world = 4
+    per_rank = 2
+    p = rng.rand(world * per_rank, 32).astype(np.float32)
+    t = rng.randint(0, 2, (world * per_rank, 32))
+    mesh = Mesh(np.array(jax.devices()[:world]), ("dp",))
+    m = AUROC().with_capacity(per_rank * 32)
+    m.update(jnp.asarray(p[0]), jnp.asarray(t[0]))
+    m.reset()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+    def fused(p_sh, t_sh):
+        st = m.init_state()
+        for i in range(per_rank):
+            st = m.pure_update(st, p_sh[0, i], t_sh[0, i])
+        synced = m.pure_sync(st, "dp")
+        return m.pure_compute(synced)  # masked rank formula — traces fine
+
+    out = jax.jit(fused)(
+        jnp.asarray(p.reshape(world, per_rank, 32)),
+        jnp.asarray(t.reshape(world, per_rank, 32)),
+    )
+    # rank-strided vs contiguous order doesn't matter: AUROC is permutation-invariant
+    np.testing.assert_allclose(float(out), roc_auc_score(t.reshape(-1), p.reshape(-1)), atol=1e-6)
+
+
+def test_fused_forward_jitted():
+    """pure_forward (state, batch) -> (state, batch_auroc) under jit."""
+    m = AUROC().with_capacity(320)
+    p = rng.rand(10, 32).astype(np.float32)
+    t = rng.randint(0, 2, (10, 32))
+    m.update(jnp.asarray(p[0]), jnp.asarray(t[0]))
+    m.reset()
+    fwd = jax.jit(m.pure_forward)
+    state = m.init_state()
+    # materialize buffers once (first trace), then steady state
+    for i in range(10):
+        state, batch_val = fwd(state, jnp.asarray(p[i]), jnp.asarray(t[i]))
+        np.testing.assert_allclose(float(batch_val), roc_auc_score(t[i], p[i]), atol=1e-6)
+    np.testing.assert_allclose(
+        float(m.pure_compute(state)), roc_auc_score(t.reshape(-1), p.reshape(-1)), atol=1e-6
+    )
