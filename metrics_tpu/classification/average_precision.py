@@ -5,13 +5,16 @@ Behavioral analogue of the reference's
 """
 from typing import Any, Callable, List, Optional, Union
 
+import jax.numpy as jnp
 from jax import Array
 
+from metrics_tpu.core.cat_buffer import CatBuffer
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
+from metrics_tpu.ops.ranking import masked_binary_average_precision
 from metrics_tpu.utils.data import dim_zero_cat
 
 
@@ -55,6 +58,25 @@ class AveragePrecision(Metric):
         self.pos_label = pos_label
 
     def compute(self) -> Union[Array, List[Array]]:
+        # Binary CatBuffer mode: static-shape step-integral AP with tie-group
+        # segment sums (ops/ranking.py) — update + sync + compute fuse into
+        # one jitted program; the curve path needs data-dependent
+        # unique-threshold sizes and is eager-only. Same value incl. ties.
+        if (
+            isinstance(self._state["preds"], CatBuffer)
+            and self.num_classes == 1
+            and self.pos_label == 1
+        ):
+            preds_cb: CatBuffer = self._state["preds"]
+            target_cb: CatBuffer = self._state["target"]
+            if preds_cb.buffer is None:
+                raise ValueError("No samples to concatenate")
+            # binarize exactly like the curve path (`target == pos_label` in
+            # `_binary_clf_curve`) — raw targets may hold values outside {0,1}
+            binary_target = (target_cb.buffer == self.pos_label).astype(jnp.float32)
+            return masked_binary_average_precision(
+                preds_cb.buffer, binary_target, preds_cb.mask()
+            )
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _average_precision_compute(

@@ -24,7 +24,22 @@ import jax
 import jax.numpy as jnp
 from jax import Array
 
-__all__ = ["masked_binary_auroc", "tie_averaged_ranks"]
+__all__ = ["masked_binary_auroc", "masked_binary_average_precision", "tie_averaged_ranks"]
+
+
+def _tie_group_ids(v_sorted: Array, valid_sorted: Array) -> Array:
+    """Segment ids of tied-value groups along a sorted order.
+
+    A validity change always starts a new group, so equal values never tie
+    across the valid/invalid boundary.
+    """
+    first = jnp.concatenate(
+        [
+            jnp.ones((1,), bool),
+            (v_sorted[1:] != v_sorted[:-1]) | (valid_sorted[1:] != valid_sorted[:-1]),
+        ]
+    )
+    return jnp.cumsum(first) - 1
 
 
 def tie_averaged_ranks(values: Array, valid: Array) -> Array:
@@ -44,21 +59,66 @@ def tie_averaged_ranks(values: Array, valid: Array) -> Array:
     pos = jnp.arange(1, n + 1) - n_invalid
     pos = pos.astype(values.dtype)
     w = valid_sorted.astype(values.dtype)
-    # tie groups along the sorted order; a validity change always starts a new
-    # group so equal values never tie across the valid/invalid boundary
-    first = jnp.concatenate(
-        [
-            jnp.ones((1,), bool),
-            (v_sorted[1:] != v_sorted[:-1]) | (valid_sorted[1:] != valid_sorted[:-1]),
-        ]
-    )
-    gid = jnp.cumsum(first) - 1
+    gid = _tie_group_ids(v_sorted, valid_sorted)
     sum_pos = jax.ops.segment_sum(pos * w, gid, num_segments=n)
     cnt = jax.ops.segment_sum(w, gid, num_segments=n)
     rank_sorted = (sum_pos / jnp.maximum(cnt, 1.0))[gid]
     # scatter back to original row order
     ranks = jnp.zeros_like(rank_sorted).at[order].set(rank_sorted)
     return ranks
+
+
+def masked_binary_average_precision(
+    preds: Array, target: Array, mask: Optional[Array] = None
+) -> Array:
+    """Exact binary average precision over the rows where ``mask`` — jittable.
+
+    Step-integral definition ``AP = Σ_k (R_k − R_{k−1})·P_k`` over *unique*
+    descending thresholds (sklearn/reference semantics: each tied score group
+    contributes once, evaluated at the group's cumulative counts). Tie groups
+    are handled with segment sums at static shape: every row computes its
+    group's recall increment, but only the last row of each group (where the
+    cumulative precision is the group's) contributes to the sum.
+
+    Returns NaN when no valid positives exist, matching ``0/0`` curve
+    semantics.
+    """
+    preds = jnp.asarray(preds, jnp.float32).reshape(-1)
+    target = jnp.asarray(target).reshape(-1).astype(jnp.float32)
+    valid = jnp.ones(preds.shape, bool) if mask is None else jnp.asarray(mask, bool).reshape(-1)
+    n = preds.shape[0]
+
+    # valid rows first, descending score
+    order = jnp.lexsort((-preds, ~valid))
+    t_sorted = jnp.where(valid[order], target[order], 0.0)
+    v_sorted = preds[order]
+    valid_sorted = valid[order]
+    w = valid_sorted.astype(jnp.float32)
+
+    tps = jnp.cumsum(t_sorted * w)
+    fps = jnp.cumsum((1.0 - t_sorted) * w)
+    n_pos = tps[-1] if n > 0 else jnp.asarray(0.0)
+
+    precision = tps / jnp.maximum(tps + fps, 1.0)
+    # last row of each tie group among valid rows: next value differs, next row
+    # is invalid, or end of array
+    next_differs = jnp.concatenate(
+        [
+            (v_sorted[1:] != v_sorted[:-1]) | (~valid_sorted[1:]),
+            jnp.ones((1,), bool),
+        ]
+    )
+    is_group_end = next_differs & valid_sorted
+
+    # recall increment of the whole group, available at its end row:
+    # R_end − R_prev_end = (tps_end − tps_prev_end) / n_pos. tps_prev_end is
+    # the cumsum at the previous group's end — reconstruct via segment sums.
+    gid = _tie_group_ids(v_sorted, valid_sorted)
+    group_pos = jax.ops.segment_sum(t_sorted * w, gid, num_segments=n)[gid]
+
+    contrib = jnp.where(is_group_end, group_pos * precision, 0.0)
+    ap = jnp.sum(contrib) / n_pos  # NaN when n_pos == 0, matching 0/0 curves
+    return ap
 
 
 def masked_binary_auroc(preds: Array, target: Array, mask: Optional[Array] = None) -> Array:

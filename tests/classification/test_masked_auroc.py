@@ -13,8 +13,14 @@ import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 from sklearn.metrics import roc_auc_score
 
-from metrics_tpu import AUROC
-from metrics_tpu.ops.ranking import masked_binary_auroc, tie_averaged_ranks
+from sklearn.metrics import average_precision_score
+
+from metrics_tpu import AUROC, AveragePrecision
+from metrics_tpu.ops.ranking import (
+    masked_binary_auroc,
+    masked_binary_average_precision,
+    tie_averaged_ranks,
+)
 
 rng = np.random.RandomState(21)
 
@@ -88,6 +94,79 @@ def test_auroc_degenerate_single_class():
     p = rng.rand(32).astype(np.float32)
     assert float(masked_binary_auroc(jnp.asarray(p), jnp.zeros(32))) == 0.5
     assert float(masked_binary_auroc(jnp.asarray(p), jnp.ones(32))) == 0.5
+
+
+@pytest.mark.parametrize("n", [16, 321, 2048])
+def test_average_precision_parity_continuous(n):
+    p = rng.rand(n).astype(np.float32)
+    t = rng.randint(0, 2, n)
+    got = float(masked_binary_average_precision(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(got, average_precision_score(t, p), atol=1e-6)
+
+
+def test_average_precision_parity_heavy_ties():
+    p = (rng.randint(0, 5, 400) / 4.0).astype(np.float32)
+    t = rng.randint(0, 2, 400)
+    got = float(masked_binary_average_precision(jnp.asarray(p), jnp.asarray(t)))
+    np.testing.assert_allclose(got, average_precision_score(t, p), atol=1e-6)
+
+
+def test_average_precision_mask_equals_slice():
+    p = rng.rand(256).astype(np.float32)
+    t = rng.randint(0, 2, 256)
+    mask = np.arange(256) < 100
+    got = float(
+        masked_binary_average_precision(jnp.asarray(p), jnp.asarray(t), jnp.asarray(mask))
+    )
+    np.testing.assert_allclose(got, average_precision_score(t[:100], p[:100]), atol=1e-6)
+
+
+def test_average_precision_no_positives_nan():
+    p = rng.rand(32).astype(np.float32)
+    assert np.isnan(float(masked_binary_average_precision(jnp.asarray(p), jnp.zeros(32))))
+
+
+def test_catbuffer_average_precision_matches_list_mode():
+    p = rng.rand(10, 32).astype(np.float32)
+    t = rng.randint(0, 2, (10, 32))
+    m_list, m_cb = AveragePrecision(), AveragePrecision().with_capacity(512)
+    for i in range(10):
+        m_list.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+        m_cb.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    np.testing.assert_allclose(float(m_cb.compute()), float(m_list.compute()), atol=1e-6)
+    np.testing.assert_allclose(
+        float(m_cb.compute()), average_precision_score(t.reshape(-1), p.reshape(-1)), atol=1e-6
+    )
+
+
+def test_catbuffer_ap_binarizes_nonbinary_targets():
+    """Raw targets outside {0,1} must binarize via pos_label like the curve
+    path (one-vs-rest over raw labels), not act as weights."""
+    p = rng.rand(200).astype(np.float32)
+    t = rng.randint(0, 3, 200)  # values {0,1,2}
+    m_list, m_cb = AveragePrecision(), AveragePrecision().with_capacity(256)
+    m_list.update(jnp.asarray(p), jnp.asarray(t))
+    m_cb.update(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(float(m_cb.compute()), float(m_list.compute()), atol=1e-6)
+    np.testing.assert_allclose(
+        float(m_cb.compute()), average_precision_score((t == 1).astype(int), p), atol=1e-6
+    )
+
+
+def test_fused_average_precision_jitted():
+    m = AveragePrecision().with_capacity(320)
+    p = rng.rand(10, 32).astype(np.float32)
+    t = rng.randint(0, 2, (10, 32))
+    m.update(jnp.asarray(p[0]), jnp.asarray(t[0]))
+    m.reset()
+    step = jax.jit(m.pure_update)
+    state = m.init_state()
+    for i in range(10):
+        state = step(state, jnp.asarray(p[i]), jnp.asarray(t[i]))
+    val = jax.jit(m.pure_compute)(state)  # compute itself traces
+    np.testing.assert_allclose(
+        float(val), average_precision_score(t.reshape(-1), p.reshape(-1)), atol=1e-6
+    )
 
 
 def test_catbuffer_auroc_compute_matches_list_mode():
