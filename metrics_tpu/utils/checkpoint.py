@@ -1,0 +1,130 @@
+"""Durable metric checkpointing via orbax — the TPU-ecosystem standard.
+
+The reference persists metric state through ``nn.Module.state_dict`` inside
+the host framework's checkpoint (reference ``metric.py:526-569``); the
+documented pattern for *globally consistent* checkpoints wraps ``state_dict``
+in ``sync_context()`` (reference ``tests/bases/test_ddp.py:226-234``).
+
+Here the same ``state_dict``/``load_state_dict`` surface exists on every
+metric and collection; this module adds orbax-backed durability:
+
+    from metrics_tpu.utils.checkpoint import save_metric, restore_metric
+    save_metric("/ckpt/metrics", collection)          # async-safe, atomic
+    restore_metric("/ckpt/metrics", collection)       # resumes accumulation
+
+``state_dict`` trees mix numpy arrays with structural values (list states,
+CatBuffer records with a possibly-absent buffer, int capacities); orbax
+persists pytrees of arrays, so the tree is encoded to arrays-only on save and
+decoded on restore.
+"""
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+__all__ = ["save_metric", "restore_metric", "save_state_dict", "restore_state_dict"]
+
+_LIST_KEY = "__list__"
+_ABSENT_KEY = "__absent__"
+
+
+def _encode(value: Any) -> Any:
+    """state_dict value → arrays-only nested dict (orbax-serializable)."""
+    if value is None:
+        return {_ABSENT_KEY: np.zeros((0,), np.int8)}
+    if isinstance(value, (int, float, bool)):
+        return np.asarray(value)
+    if isinstance(value, list):
+        enc = {_LIST_KEY: np.asarray(len(value))}
+        for i, item in enumerate(value):
+            enc[str(i)] = _encode(item)
+        return enc
+    if isinstance(value, dict):
+        return {k: _encode(v) for k, v in value.items()}
+    return np.asarray(value)
+
+
+def _decode(value: Any) -> Any:
+    if isinstance(value, dict):
+        if _ABSENT_KEY in value:
+            return None
+        if _LIST_KEY in value:
+            n = int(np.asarray(value[_LIST_KEY]))
+            return [_decode(value[str(i)]) for i in range(n)]
+        out = {}
+        for k, v in value.items():
+            dec = _decode(v)
+            # scalar structural ints (e.g. CatBuffer capacity) come back as
+            # 0-d arrays; load_state_dict expects plain ints there
+            if k == "__catbuffer__":
+                dec = int(np.asarray(dec))
+            out[k] = dec
+        return out
+    return np.asarray(value)
+
+
+def save_state_dict(directory: str, state_dict: Dict[str, Any]) -> None:
+    """Atomically persist a metric/collection ``state_dict`` to ``directory``."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(directory)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, _encode(state_dict), force=True)
+
+
+def restore_state_dict(directory: str) -> Dict[str, Any]:
+    """Load a ``state_dict`` previously written by :func:`save_state_dict`."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(directory)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        raw = ckptr.restore(path)
+    return _decode(raw)
+
+
+def save_metric(directory: str, metric: Any) -> None:
+    """Persist a metric's (or MetricCollection's) accumulated state.
+
+    All states are saved regardless of their ``persistent`` flag — a
+    checkpoint that silently drops non-persistent accumulators cannot resume
+    an eval; the flag still governs what the in-framework ``state_dict``
+    exposes to host frameworks (reference semantics, ``metric.py:117``).
+    """
+    was = _set_all_persistent(metric, True)
+    try:
+        save_state_dict(directory, metric.state_dict())
+    finally:
+        _restore_persistent(metric, was)
+
+
+def restore_metric(directory: str, metric: Any) -> Any:
+    """Restore a metric (or MetricCollection) saved by :func:`save_metric`.
+
+    Returns ``metric`` with its accumulation resumed; further ``update`` calls
+    continue from the checkpointed state.
+    """
+    metric.load_state_dict(restore_state_dict(directory))
+    return metric
+
+
+def _set_all_persistent(metric: Any, mode: bool) -> Dict[int, Dict[str, bool]]:
+    saved: Dict[int, Dict[str, bool]] = {}
+    for m in _leaf_metrics(metric):
+        saved[id(m)] = dict(m._persistent)
+        m.persistent(mode)
+    return saved
+
+
+def _restore_persistent(metric: Any, saved: Dict[int, Dict[str, bool]]) -> None:
+    for m in _leaf_metrics(metric):
+        m._persistent.update(saved[id(m)])
+
+
+def _leaf_metrics(metric: Any):
+    from metrics_tpu.core.collections import MetricCollection
+
+    if isinstance(metric, MetricCollection):
+        for _, m in metric.items():
+            yield m
+    else:
+        yield metric
