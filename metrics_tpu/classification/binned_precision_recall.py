@@ -16,6 +16,7 @@ from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute_with_precision_recall,
 )
+from metrics_tpu.ops.pallas_binned import binned_stat_scores
 from metrics_tpu.utils.data import METRIC_EPS, to_onehot
 
 
@@ -85,13 +86,13 @@ class BinnedPrecisionRecallCurve(Metric):
             target = target.reshape(-1, 1)
         if preds.ndim == target.ndim + 1:
             target = to_onehot(target, num_classes=self.num_classes)
-        target = target == 1
-        # [N, C, T] comparison fused by XLA; sums land in [C, T] states
-        predictions = preds[:, :, None] >= self.thresholds[None, None, :]
-        t = target[:, :, None]
-        self.TPs = self.TPs + jnp.sum(t & predictions, axis=0)
-        self.FPs = self.FPs + jnp.sum(~t & predictions, axis=0)
-        self.FNs = self.FNs + jnp.sum(t & ~predictions, axis=0)
+        target = (target == 1).astype(jnp.float32)
+        # TPU: pallas kernel streaming [N, C] once through VMEM with [C, T]
+        # accumulators on-chip; elsewhere: fused-XLA broadcast compare
+        tp, fp, fn = binned_stat_scores(preds, target, self.thresholds)
+        self.TPs = self.TPs + tp
+        self.FPs = self.FPs + fp
+        self.FNs = self.FNs + fn
 
     def compute(
         self,

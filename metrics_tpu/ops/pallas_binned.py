@@ -1,0 +1,172 @@
+"""Pallas TPU kernel for the binned PR-curve hot op.
+
+The binned family (reference ``torchmetrics/classification/
+binned_precision_recall.py:147-174``) accumulates TP/FP/FN counts of shape
+``[num_classes, num_thresholds]`` from ``[N, C]`` probability batches. The
+straightforward XLA formulation broadcasts an ``[N, C, T]`` boolean
+comparison and reduces over N — at large ``N*C*T`` that materializes
+multi-hundred-MB intermediates in HBM.
+
+This kernel restructures the op for the TPU memory hierarchy:
+
+- inputs are transposed to **class-major** ``[C, N]`` so the class axis rides
+  the sublanes and the batch axis rides the 128-wide lanes;
+- the batch is **streamed through VMEM once** in ``[C, block]`` tiles; per
+  tile, thresholds are processed in small chunks, each chunk doing a
+  ``[TC, C, block]`` compare + lane-reduction on the VPU — nothing of size
+  ``N*T`` ever exists in HBM;
+- the ``[T, C]`` TP/count accumulators live in VMEM across grid steps;
+  FP and FN are derived algebraically (``FP = CNT - TP``, ``FN = POS - TP``).
+
+Use :func:`binned_stat_scores` — it dispatches to the kernel on TPU backends
+and to the fused-XLA path elsewhere (CPU tests run the kernel in interpreter
+mode to validate it against the XLA path).
+"""
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+__all__ = ["binned_stat_scores"]
+
+_LANE = 128  # TPU lane width
+_SUBLANE = 8  # float32 sublane tile
+_BLOCK_N = 2048  # batch elements per grid step (lane-dim tiles)
+_THRESH_CHUNK = 16  # thresholds per inner-loop step
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _binned_stats_xla(preds: Array, target: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
+    """Fused-XLA reference path: broadcast compare + reduce (CPU default)."""
+    predictions = preds[:, :, None] >= thresholds[None, None, :]
+    t = target[:, :, None].astype(bool)
+    tp = jnp.sum(t & predictions, axis=0).astype(jnp.float32)
+    fp = jnp.sum(~t & predictions, axis=0).astype(jnp.float32)
+    fn = jnp.sum(t & ~predictions, axis=0).astype(jnp.float32)
+    return tp, fp, fn
+
+
+def _kernel(x_ref, w_ref, thr_ref, tp_ref, cnt_ref, pos_ref, *, t_chunks: int):
+    """One grid step: a [C, block] tile of the class-major stream.
+
+    x_ref/w_ref: [Cp, BN] probabilities / {0,1} weights.
+    thr_ref:     [Tp, 1] thresholds.
+    tp_ref/cnt_ref: [Tp, Cp] accumulators; pos_ref: [1, Cp].
+    """
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        tp_ref[:] = jnp.zeros_like(tp_ref)
+        cnt_ref[:] = jnp.zeros_like(cnt_ref)
+        pos_ref[:] = jnp.zeros_like(pos_ref)
+
+    x = x_ref[:]  # [Cp, BN]
+    w = w_ref[:]
+
+    def body(k, _):
+        i0 = k * _THRESH_CHUNK
+        thr_c = thr_ref[pl.ds(i0, _THRESH_CHUNK), :]  # [TC, 1]
+        # [TC, Cp, BN] compare lives only in registers/VMEM for this chunk
+        cmp = (x[None, :, :] >= thr_c[:, :, None]).astype(jnp.float32)
+        tp_ref[pl.ds(i0, _THRESH_CHUNK), :] += jnp.sum(w[None, :, :] * cmp, axis=2)
+        cnt_ref[pl.ds(i0, _THRESH_CHUNK), :] += jnp.sum(cmp, axis=2)
+        return 0
+
+    jax.lax.fori_loop(0, t_chunks, body, 0)
+    pos_ref[0, :] += jnp.sum(w, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _binned_stats_pallas(
+    preds: Array, target: Array, thresholds: Array, interpret: bool = False
+) -> Tuple[Array, Array, Array]:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, c = preds.shape
+    t = thresholds.shape[0]
+    tp_pad = _ceil_to(t, max(_THRESH_CHUNK, _SUBLANE))
+    cp = _ceil_to(c, _SUBLANE)
+    block = min(_BLOCK_N, _ceil_to(n, _LANE))
+    np_ = _ceil_to(n, block)
+
+    # class-major stream; batch padding gets -inf probs (matches no finite
+    # threshold) / 0 weights, threshold padding is +inf (matches no element)
+    x = jnp.full((cp, np_), -jnp.inf, jnp.float32)
+    x = x.at[:c, :n].set(preds.T.astype(jnp.float32))
+    w = jnp.zeros((cp, np_), jnp.float32).at[:c, :n].set(target.T.astype(jnp.float32))
+    thr = jnp.full((tp_pad, 1), jnp.inf, jnp.float32).at[:t, 0].set(thresholds.astype(jnp.float32))
+
+    kernel = functools.partial(_kernel, t_chunks=tp_pad // _THRESH_CHUNK)
+    tp, cnt, pos = pl.pallas_call(
+        kernel,
+        grid=(np_ // block,),
+        in_specs=[
+            pl.BlockSpec((cp, block), lambda i: (0, i)),
+            pl.BlockSpec((cp, block), lambda i: (0, i)),
+            pl.BlockSpec((tp_pad, 1), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tp_pad, cp), lambda i: (0, 0)),
+            pl.BlockSpec((tp_pad, cp), lambda i: (0, 0)),
+            pl.BlockSpec((1, cp), lambda i: (0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((tp_pad, cp), jnp.float32),
+            jax.ShapeDtypeStruct((tp_pad, cp), jnp.float32),
+            jax.ShapeDtypeStruct((1, cp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, w, thr)
+
+    tp = tp[:t, :c].T  # [C, T]
+    fp = cnt[:t, :c].T - tp
+    fn = pos[0, :c, None] - tp
+    return tp, fp, fn
+
+
+def _vmem_budget_ok(n: int, c: int, t: int) -> bool:
+    """Live VMEM: in tiles + [Tp,Cp] accumulators + one [TC,Cp,block] chunk."""
+    cp = _ceil_to(c, _SUBLANE)
+    tp_pad = _ceil_to(t, max(_THRESH_CHUNK, _SUBLANE))
+    block = min(_BLOCK_N, _ceil_to(n, _LANE))
+    live = (2 * cp * block + 2 * tp_pad * cp + 2 * _THRESH_CHUNK * cp * block) * 4
+    return live < 8 * 1024 * 1024
+
+
+def binned_stat_scores(
+    preds: Array,
+    target: Array,
+    thresholds: Array,
+    use_pallas: Optional[bool] = None,
+    interpret: bool = False,
+) -> Tuple[Array, Array, Array]:
+    """Per-class, per-threshold (TP, FP, FN) counts for binned PR metrics.
+
+    Args:
+        preds: ``[N, C]`` probabilities.
+        target: ``[N, C]`` binary labels.
+        thresholds: ``[T]`` decision thresholds.
+        use_pallas: force the kernel on/off; default auto (TPU backend only,
+            within VMEM budget).
+        interpret: run the kernel in interpreter mode (CPU testing).
+
+    Returns:
+        Three ``[C, T]`` float32 arrays: true/false positives and false
+        negatives at each (class, threshold).
+    """
+    n, c = preds.shape
+    t = thresholds.shape[0]
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and _vmem_budget_ok(n, c, t)
+    if use_pallas or interpret:
+        return _binned_stats_pallas(preds, target, thresholds, interpret=interpret)
+    return _binned_stats_xla(preds, target, thresholds)
