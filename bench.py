@@ -1,22 +1,44 @@
-"""Benchmark: fused metric-step throughput on the available accelerator.
+"""Benchmarks on the available accelerator.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Default (driver contract): runs BASELINE config 1 and prints ONE JSON line
+``{"metric", "value", "unit", "vs_baseline"}``.
 
-Config 1 of BASELINE.md: Accuracy (10-class) + StatScores in a MetricCollection.
-The baseline proxy is a faithful torch-CPU implementation of the same
-accumulation (the reference publishes no numbers — BASELINE.md), timed in-process.
+``python bench.py --all`` additionally runs BASELINE configs 2-5 (one JSON
+line each; see BASELINE.md for the config table and BENCH.md for recorded
+numbers).
+
+The baseline proxy for config 1 is a faithful torch-CPU implementation of the
+same accumulation (the reference publishes no performance numbers —
+BASELINE.md), timed in-process.
 """
 import json
+import sys
 import time
 
 import numpy as np
 
 BATCH = 2048
 NUM_CLASSES = 10
-STEPS = 50
+STEPS = 200
+
+
+def _time_steps(fn, *args, steps=STEPS, warm=20):
+    """Median-free simple wall-clock: warm the dispatch path, then average."""
+    import jax
+
+    out = None
+    for _ in range(warm):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / steps
 
 
 def bench_ours() -> float:
+    """Config 1: Accuracy + StatScores fused update step."""
     import jax
     import jax.numpy as jnp
 
@@ -35,13 +57,19 @@ def bench_ours() -> float:
     state = mc.init_state()
     state = step(state, preds, target)  # compile
     jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    for _ in range(STEPS):
-        state = step(state, preds, target)
-    jax.block_until_ready(state)
-    dt = (time.perf_counter() - t0) / STEPS
+
+    class _Loop:
+        def __init__(self):
+            self.state = state
+
+        def __call__(self, p, t):
+            self.state = step(self.state, p, t)
+            return self.state
+
+    loop = _Loop()
+    dt = _time_steps(loop, preds, target)
     # sanity: value must be finite
-    vals = mc.pure_compute(state)
+    vals = mc.pure_compute(loop.state)
     assert np.isfinite(float(np.asarray(vals["acc"]))), "bench produced non-finite metric"
     return dt
 
@@ -77,6 +105,117 @@ def bench_torch_baseline() -> float:
     return (time.perf_counter() - t0) / STEPS
 
 
+def _emit(metric, value, unit, vs=None):
+    print(json.dumps({"metric": metric, "value": value, "unit": unit, "vs_baseline": vs}))
+
+
+def bench_config2() -> None:
+    """Config 2: AUROC (CatBuffer cat-state) + ConfusionMatrix collection."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import AUROC, ConfusionMatrix, MetricCollection
+
+    batch, steps_cap = 1024, 64
+    mc = MetricCollection(
+        {
+            "auroc": AUROC().with_capacity(batch * steps_cap),
+            "confmat": ConfusionMatrix(num_classes=2),
+        }
+    )
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(batch).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, (batch,)))
+    mc.update(preds, target)  # warm eager mode detection
+    state0 = mc.init_state()
+    step = jax.jit(mc.pure_update, donate_argnums=(0,))
+    state = step(state0, preds, target)
+    jax.block_until_ready(state)
+
+    holder = {"s": state}
+
+    def loop(p, t):
+        holder["s"] = step(holder["s"], p, t)
+        return holder["s"]
+
+    dt = _time_steps(loop, preds, target, steps=steps_cap - 21, warm=20)
+    val = mc.pure_compute(holder["s"])
+    assert np.isfinite(float(np.asarray(val["auroc"])))
+    _emit("auroc_confmat_fused_step", round(dt * 1e6, 2), "us/step")
+
+
+def bench_config3() -> None:
+    """Config 3: FID — Inception-v3 forward + streaming moments on device."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import FID
+
+    fid = FID(feature=2048, streaming=True)
+    batch = 32
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(rng.rand(batch, 3, 299, 299).astype(np.float32))
+
+    fid.update(imgs, real=True)  # compile both paths
+    fid.update(imgs, real=False)
+
+    def step(im):
+        fid.update(im, real=True)
+        return fid.real_n
+
+    dt = _time_steps(step, imgs, steps=8, warm=2)
+    t0 = time.perf_counter()
+    val = fid.compute()
+    jax.block_until_ready(val)
+    dt_compute = time.perf_counter() - t0
+    _emit("fid_inception_forward", round(batch / dt, 1), "imgs/s")
+    _emit("fid_compute_sqrtm", round(dt_compute, 3), "s")
+
+
+def bench_config4() -> None:
+    """Config 4: BERTScore — in-framework BERT forward as the scoring engine."""
+    import jax
+
+    from metrics_tpu import BERTScore
+
+    sents_per_batch = 64
+    bs = BERTScore(max_length=64, batch_size=sents_per_batch)
+    preds = ["the quick brown fox jumps over the lazy dog"] * sents_per_batch
+    refs = ["a quick brown fox jumped over lazy dogs"] * sents_per_batch
+    for _ in range(4):
+        bs.update(preds, refs)
+    t0 = time.perf_counter()
+    out = bs.compute()
+    jax.block_until_ready(out["f1"])
+    dt = time.perf_counter() - t0
+    _emit("bertscore_compute", round(4 * sents_per_batch / dt, 1), "sentences/s")
+
+
+def bench_config5() -> None:
+    """Config 5: RetrievalMAP + NDCG over ragged query groups (segment ops)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import RetrievalMAP, RetrievalNormalizedDCG
+
+    n, queries = 65536, 1024
+    rng = np.random.RandomState(0)
+    idx = jnp.asarray(rng.randint(0, queries, (n,)))
+    preds = jnp.asarray(rng.rand(n).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, 2, (n,)))
+
+    m_map = RetrievalMAP()
+    m_ndcg = RetrievalNormalizedDCG()
+    m_map.update(preds, target, idx)
+    m_ndcg.update(preds, target, idx)
+
+    t0 = time.perf_counter()
+    v1 = m_map.compute()
+    v2 = m_ndcg.compute()
+    dt = time.perf_counter() - t0
+    assert np.isfinite(float(np.asarray(v1))) and np.isfinite(float(np.asarray(v2)))
+    _emit("retrieval_map_ndcg_compute", round(dt * 1e3, 2), "ms/65536-docs")
+
+
 def main() -> None:
     ours = bench_ours()
     try:
@@ -84,16 +223,12 @@ def main() -> None:
         vs = base / ours
     except Exception:
         vs = None
-    print(
-        json.dumps(
-            {
-                "metric": "fused_metric_step_time",
-                "value": round(ours * 1e6, 2),
-                "unit": "us/step",
-                "vs_baseline": round(vs, 3) if vs else None,
-            }
-        )
-    )
+    _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
+    if "--all" in sys.argv:
+        bench_config2()
+        bench_config3()
+        bench_config4()
+        bench_config5()
 
 
 if __name__ == "__main__":

@@ -24,7 +24,17 @@ from metrics_tpu.utils.enums import DataType
 
 
 class Accuracy(StatScores):
-    r"""Accuracy :math:`\frac{1}{N}\sum_i^N 1(y_i = \hat{y}_i)`."""
+    r"""Accuracy :math:`\frac{1}{N}\sum_i^N 1(y_i = \hat{y}_i)`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> accuracy = Accuracy(num_classes=4)
+        >>> print(round(float(accuracy(preds, target)), 4))
+        0.5
+    """
 
     is_differentiable = False
 

@@ -13,7 +13,17 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class AUC(Metric):
-    """Area under any accumulated (x, y) curve via the trapezoidal rule."""
+    """Area under any accumulated (x, y) curve via the trapezoidal rule.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUC
+        >>> x = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> y = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+        >>> auc = AUC()
+        >>> print(round(float(auc(x, y)), 4))
+        4.0
+    """
 
     is_differentiable = False
 

@@ -16,7 +16,17 @@ from metrics_tpu.utils.enums import AverageMethod, DataType
 
 
 class AUROC(Metric):
-    """Area under the ROC curve, accumulated over batches via cat-states."""
+    """Area under the ROC curve, accumulated over batches via cat-states.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AUROC
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> auroc = AUROC()
+        >>> print(round(float(auroc(preds, target)), 4))
+        0.75
+    """
 
     is_differentiable = False
 

@@ -19,7 +19,17 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class AveragePrecision(Metric):
-    """Area under the precision-recall step curve, over accumulated batches."""
+    """Area under the precision-recall step curve, over accumulated batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AveragePrecision
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> average_precision = AveragePrecision()
+        >>> print(round(float(average_precision(preds, target)), 4))
+        0.8333
+    """
 
     is_differentiable = False
 

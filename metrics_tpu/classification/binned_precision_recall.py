@@ -45,7 +45,18 @@ def _recall_at_precision(
 
 
 class BinnedPrecisionRecallCurve(Metric):
-    """Constant-memory PR curve over fixed threshold bins."""
+    """Constant-memory PR curve over fixed threshold bins.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedAveragePrecision
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> binned_ap = BinnedAveragePrecision(num_classes=1, thresholds=5)
+        >>> binned_ap.update(preds, target)
+        >>> print(round(float(binned_ap.compute()), 4))
+        0.8333
+    """
 
     def __init__(
         self,

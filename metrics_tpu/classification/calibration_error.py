@@ -14,7 +14,18 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class CalibrationError(Metric):
-    r"""Top-label calibration error: L1 (ECE), L2 (RMSCE) or max (MCE) norm."""
+    r"""Top-label calibration error: L1 (ECE), L2 (RMSCE) or max (MCE) norm.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CalibrationError
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> ece = CalibrationError(n_bins=3)
+        >>> ece.update(preds, target)
+        >>> print(round(float(ece.compute()), 4))
+        0.1375
+    """
 
     DISTANCES = {"l1", "l2", "max"}
 

@@ -16,7 +16,17 @@ from metrics_tpu.functional.classification.cohen_kappa import (
 
 
 class CohenKappa(Metric):
-    r"""Cohen's kappa from an accumulated confusion matrix."""
+    r"""Cohen's kappa from an accumulated confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CohenKappa
+        >>> preds = jnp.asarray([1, 0, 1, 1])
+        >>> target = jnp.asarray([1, 0, 0, 1])
+        >>> cohenkappa = CohenKappa(num_classes=2)
+        >>> print(round(float(cohenkappa(preds, target)), 4))
+        0.5
+    """
 
     is_differentiable = False
 

@@ -17,7 +17,17 @@ from metrics_tpu.functional.classification.confusion_matrix import (
 
 
 class ConfusionMatrix(Metric):
-    """Confusion matrix with optional 'true'/'pred'/'all' normalization."""
+    """Confusion matrix with optional 'true'/'pred'/'all' normalization.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ConfusionMatrix
+        >>> preds = jnp.asarray([1, 0, 1, 1])
+        >>> target = jnp.asarray([1, 0, 0, 1])
+        >>> confmat = ConfusionMatrix(num_classes=2)
+        >>> print(confmat(preds, target).tolist())
+        [[1, 1], [0, 2]]
+    """
 
     is_differentiable = False
 

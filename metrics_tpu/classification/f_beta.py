@@ -12,7 +12,17 @@ from metrics_tpu.functional.classification.f_beta import _fbeta_compute
 
 
 class FBeta(StatScores):
-    r"""F-beta score, weighting recall by ``beta`` (reference ``f_beta.py:29``)."""
+    r"""F-beta score, weighting recall by ``beta`` (reference ``f_beta.py:29``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import FBeta
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> f_beta = FBeta(num_classes=4, beta=0.5)
+        >>> print(round(float(f_beta(preds, target)), 4))
+        0.5
+    """
 
     is_differentiable = False
 
@@ -60,7 +70,17 @@ class FBeta(StatScores):
 
 
 class F1(FBeta):
-    r"""F1 = F-beta with beta=1 (reference ``f_beta.py:181``)."""
+    r"""F1 = F-beta with beta=1 (reference ``f_beta.py:181``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import F1
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> f1 = F1(num_classes=4)
+        >>> print(round(float(f1(preds, target)), 4))
+        0.5
+    """
 
     is_differentiable = False
 

@@ -16,7 +16,17 @@ from metrics_tpu.functional.classification.hamming_distance import (
 
 
 class HammingDistance(Metric):
-    r"""Average Hamming loss: fraction of wrongly predicted labels."""
+    r"""Average Hamming loss: fraction of wrongly predicted labels.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import HammingDistance
+        >>> preds = jnp.asarray([[0, 1], [1, 1]])
+        >>> target = jnp.asarray([[0, 1], [0, 1]])
+        >>> hamming = HammingDistance()
+        >>> print(round(float(hamming(preds, target)), 4))
+        0.25
+    """
 
     is_differentiable = False
 

@@ -17,7 +17,17 @@ from metrics_tpu.functional.classification.hinge import (
 
 
 class Hinge(Metric):
-    r"""Mean hinge loss for binary, Crammer-Singer or one-vs-all inputs."""
+    r"""Mean hinge loss for binary, Crammer-Singer or one-vs-all inputs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Hinge
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> hinge = Hinge()
+        >>> print(round(float(hinge(preds, target)), 4))
+        0.3
+    """
 
     is_differentiable = True
 

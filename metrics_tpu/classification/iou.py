@@ -12,7 +12,17 @@ from metrics_tpu.functional.classification.iou import _iou_from_confmat
 
 
 class IoU(ConfusionMatrix):
-    r"""Jaccard index from an accumulated confusion matrix."""
+    r"""Jaccard index from an accumulated confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import IoU
+        >>> preds = jnp.asarray([1, 0, 1, 1])
+        >>> target = jnp.asarray([1, 0, 0, 1])
+        >>> iou = IoU(num_classes=2)
+        >>> print(round(float(iou(preds, target)), 4))
+        0.5833
+    """
 
     is_differentiable = False
 

@@ -15,7 +15,17 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 class KLDivergence(Metric):
     r"""KL divergence accumulated over batches; sum states for mean/sum
-    reduction, cat-states for per-sample output."""
+    reduction, cat-states for per-sample output.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import KLDivergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1 / 3, 1 / 3, 1 / 3]])
+        >>> kl = KLDivergence()
+        >>> print(round(float(kl(p, q)), 4))
+        0.0853
+    """
 
     is_differentiable = True
 

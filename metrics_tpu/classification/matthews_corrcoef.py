@@ -16,7 +16,17 @@ from metrics_tpu.functional.classification.matthews_corrcoef import (
 
 
 class MatthewsCorrcoef(Metric):
-    r"""Matthews correlation coefficient from an accumulated confusion matrix."""
+    r"""Matthews correlation coefficient from an accumulated confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MatthewsCorrcoef
+        >>> preds = jnp.asarray([1, 0, 1, 1])
+        >>> target = jnp.asarray([1, 0, 0, 1])
+        >>> matthews = MatthewsCorrcoef(num_classes=2)
+        >>> print(round(float(matthews(preds, target)), 4))
+        0.5774
+    """
 
     is_differentiable = False
 

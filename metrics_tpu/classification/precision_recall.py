@@ -54,7 +54,17 @@ class _PrecisionRecallBase(StatScores):
 
 
 class Precision(_PrecisionRecallBase):
-    r"""Precision :math:`\frac{TP}{TP + FP}` (reference ``precision_recall.py:28``)."""
+    r"""Precision :math:`\frac{TP}{TP + FP}` (reference ``precision_recall.py:28``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Precision
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> precision = Precision(num_classes=4, average="macro")
+        >>> print(round(float(precision(preds, target)), 4))
+        0.5
+    """
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._get_final_stats()
@@ -62,7 +72,17 @@ class Precision(_PrecisionRecallBase):
 
 
 class Recall(_PrecisionRecallBase):
-    r"""Recall :math:`\frac{TP}{TP + FN}` (reference ``precision_recall.py:180``)."""
+    r"""Recall :math:`\frac{TP}{TP + FN}` (reference ``precision_recall.py:180``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Recall
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> recall = Recall(num_classes=4, average="macro")
+        >>> print(round(float(recall(preds, target)), 4))
+        0.5
+    """
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._get_final_stats()

@@ -17,7 +17,20 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class PrecisionRecallCurve(Metric):
-    """Precision-recall pairs over all distinct thresholds (exact)."""
+    """Precision-recall pairs over all distinct thresholds (exact).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PrecisionRecallCurve
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> pr_curve = PrecisionRecallCurve(pos_label=1)
+        >>> precision, recall, thresholds = pr_curve(preds, target)
+        >>> print([round(p, 4) for p in precision.tolist()])
+        [0.6667, 0.5, 1.0, 1.0]
+        >>> print(recall.tolist())
+        [1.0, 0.5, 0.5, 0.0]
+    """
 
     is_differentiable = False
 

@@ -13,7 +13,20 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class ROC(Metric):
-    """(fpr, tpr, thresholds) over all distinct thresholds."""
+    """(fpr, tpr, thresholds) over all distinct thresholds.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ROC
+        >>> preds = jnp.asarray([0.1, 0.4, 0.35, 0.8])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> roc = ROC(pos_label=1)
+        >>> fpr, tpr, thresholds = roc(preds, target)
+        >>> print(fpr.tolist())
+        [0.0, 0.0, 0.5, 0.5, 1.0]
+        >>> print(tpr.tolist())
+        [0.0, 0.5, 0.5, 1.0, 1.0]
+    """
 
     is_differentiable = False
 

@@ -12,7 +12,17 @@ from metrics_tpu.functional.classification.specificity import _specificity_compu
 
 
 class Specificity(StatScores):
-    r"""Specificity :math:`\frac{TN}{TN + FP}` (reference ``specificity.py:28``)."""
+    r"""Specificity :math:`\frac{TN}{TN + FP}` (reference ``specificity.py:28``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Specificity
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> specificity = Specificity(num_classes=4, average="macro")
+        >>> print(round(float(specificity(preds, target)), 4))
+        0.8333
+    """
 
     is_differentiable = False
 

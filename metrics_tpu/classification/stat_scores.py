@@ -21,7 +21,17 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class StatScores(Metric):
-    """Computes the number of true/false positives/negatives and support."""
+    """Computes the number of true/false positives/negatives and support.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import StatScores
+        >>> preds = jnp.asarray([1, 0, 1, 1])
+        >>> target = jnp.asarray([1, 0, 0, 1])
+        >>> stat_scores = StatScores(reduce="micro", num_classes=2)
+        >>> print(stat_scores(preds, target).tolist())  # tp, fp, tn, fn, support
+        [3, 1, 3, 1, 4]
+    """
 
     is_differentiable = False
 

@@ -11,7 +11,15 @@ from metrics_tpu.core.metric import Metric
 
 
 class AverageMeter(Metric):
-    """Average of a stream of (optionally weighted) values."""
+    """Average of a stream of (optionally weighted) values.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import AverageMeter
+        >>> avg = AverageMeter()
+        >>> print(round(float(avg(jnp.asarray([1.0, 2.0, 3.0]))), 4))
+        2.0
+    """
 
     def __init__(
         self,

@@ -14,6 +14,17 @@ from metrics_tpu.core.metric import Metric
 class MetricCollection(dict):
     """An ordered dict of metrics sharing a single ``update``/``forward`` call.
 
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricCollection, Precision
+        >>> mc = MetricCollection({
+        ...     "acc": Accuracy(num_classes=3),
+        ...     "prec": Precision(num_classes=3, average="macro"),
+        ... })
+        >>> vals = mc(jnp.asarray([0, 2, 1]), jnp.asarray([0, 1, 1]))
+        >>> print({k: round(float(v), 4) for k, v in sorted(vals.items())})
+        {'acc': 0.6667, 'prec': 0.6667}
+
     Args:
         metrics: one Metric, a list/tuple of Metrics, or a dict name->Metric.
         prefix / postfix: added to every key in the output dict.

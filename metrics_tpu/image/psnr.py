@@ -24,6 +24,15 @@ class PSNR(Metric):
         base: logarithm base.
         reduction: 'elementwise_mean' | 'sum' | 'none' over per-``dim`` scores.
         dim: dimensions to reduce over; ``None`` = all (scalar states).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PSNR
+        >>> preds = jnp.asarray([[[[0.0, 1.0], [2.0, 3.0]]]])
+        >>> target = jnp.asarray([[[[3.0, 2.0], [1.0, 0.0]]]])
+        >>> psnr = PSNR()
+        >>> print(round(float(psnr(preds, target)), 4))
+        2.5527
     """
 
     def __init__(

@@ -29,6 +29,15 @@ class SSIM(Metric):
         data_range: value range; if ``None`` it is inferred from the data at
             compute time (forces full input buffering, see module docstring).
         k1 / k2: SSIM stability constants.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SSIM
+        >>> preds = jnp.ones((1, 1, 16, 16)) * 0.5
+        >>> target = jnp.ones((1, 1, 16, 16)) * 0.5
+        >>> ssim = SSIM(data_range=1.0)
+        >>> print(round(float(ssim(preds, target)), 4))
+        1.0
     """
 
     def __init__(

@@ -13,7 +13,17 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class CosineSimilarity(Metric):
-    r"""Cosine similarity over accumulated rows (cat-states)."""
+    r"""Cosine similarity over accumulated rows (cat-states).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import CosineSimilarity
+        >>> preds = jnp.asarray([[1.0, 2.0, 3.0, 4.0]])
+        >>> target = jnp.asarray([[1.0, 2.0, 3.0, 4.0]])
+        >>> cosine = CosineSimilarity(reduction="mean")
+        >>> print(round(float(cosine(preds, target)), 4))
+        1.0
+    """
 
     is_differentiable = True
 

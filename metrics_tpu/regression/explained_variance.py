@@ -13,7 +13,17 @@ from metrics_tpu.functional.regression.explained_variance import (
 
 
 class ExplainedVariance(Metric):
-    r"""Explained variance via streaming moment states."""
+    r"""Explained variance via streaming moment states.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import ExplainedVariance
+        >>> preds = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> target = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> explained_variance = ExplainedVariance()
+        >>> print(round(float(explained_variance(preds, target)), 4))
+        0.9645
+    """
 
     is_differentiable = True
 

@@ -13,7 +13,17 @@ from metrics_tpu.functional.regression.mean_absolute_error import (
 
 
 class MeanAbsoluteError(Metric):
-    r"""MAE accumulated over batches."""
+    r"""MAE accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsoluteError
+        >>> preds = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+        >>> mae = MeanAbsoluteError()
+        >>> print(round(float(mae(preds, target)), 4))
+        0.25
+    """
 
     is_differentiable = True
 

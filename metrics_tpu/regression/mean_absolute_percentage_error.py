@@ -13,7 +13,17 @@ from metrics_tpu.functional.regression.mean_absolute_percentage_error import (
 
 
 class MeanAbsolutePercentageError(Metric):
-    r"""MAPE accumulated over batches."""
+    r"""MAPE accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanAbsolutePercentageError
+        >>> preds = jnp.asarray([1.0, 10.0, 1e6])
+        >>> target = jnp.asarray([0.9, 15.0, 1.2e6])
+        >>> mape = MeanAbsolutePercentageError()
+        >>> print(round(float(mape(preds, target)), 4))
+        0.2037
+    """
 
     is_differentiable = True
 

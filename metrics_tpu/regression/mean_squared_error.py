@@ -13,7 +13,17 @@ from metrics_tpu.functional.regression.mean_squared_error import (
 
 
 class MeanSquaredError(Metric):
-    r"""MSE (or RMSE with ``squared=False``), accumulated over batches."""
+    r"""MSE (or RMSE with ``squared=False``), accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredError
+        >>> preds = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+        >>> mse = MeanSquaredError()
+        >>> print(round(float(mse(preds, target)), 4))
+        0.25
+    """
 
     is_differentiable = True
 

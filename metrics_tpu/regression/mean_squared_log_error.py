@@ -13,7 +13,17 @@ from metrics_tpu.functional.regression.mean_squared_log_error import (
 
 
 class MeanSquaredLogError(Metric):
-    r"""MSLE accumulated over batches."""
+    r"""MSLE accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MeanSquaredLogError
+        >>> preds = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+        >>> msle = MeanSquaredLogError()
+        >>> print(round(float(msle(preds, target)), 4))
+        0.0207
+    """
 
     is_differentiable = True
 

@@ -50,7 +50,17 @@ def _final_aggregation(
 
 
 class PearsonCorrcoef(Metric):
-    r"""Pearson correlation via mergeable running moments."""
+    r"""Pearson correlation via mergeable running moments.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import PearsonCorrcoef
+        >>> preds = jnp.asarray([2.0, 2.0, 2.0, 2.0, 6.0])
+        >>> target = jnp.asarray([1.0, 2.0, 3.0, 4.0, 5.0])
+        >>> pearson = PearsonCorrcoef()
+        >>> print(round(float(pearson(preds, target)), 4))
+        0.7071
+    """
 
     is_differentiable = True
 

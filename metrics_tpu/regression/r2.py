@@ -10,7 +10,17 @@ from metrics_tpu.functional.regression.r2 import _r2_score_compute, _r2_score_up
 
 
 class R2Score(Metric):
-    r"""R² with optional adjustment and multioutput aggregation."""
+    r"""R² with optional adjustment and multioutput aggregation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import R2Score
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> r2 = R2Score()
+        >>> print(round(float(r2(preds, target)), 4))
+        0.9486
+    """
 
     is_differentiable = True
 

@@ -14,7 +14,17 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class SpearmanCorrcoef(Metric):
-    r"""Spearman rank correlation over accumulated samples (cat-states)."""
+    r"""Spearman rank correlation over accumulated samples (cat-states).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SpearmanCorrcoef
+        >>> preds = jnp.asarray([2.0, 2.0, 2.0, 2.0, 6.0])
+        >>> target = jnp.asarray([1.0, 2.0, 3.0, 4.0, 5.0])
+        >>> spearman = SpearmanCorrcoef()
+        >>> print(round(float(spearman(preds, target)), 4))
+        0.7071
+    """
 
     is_differentiable = False
 

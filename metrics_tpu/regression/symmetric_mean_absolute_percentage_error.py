@@ -13,7 +13,17 @@ from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error 
 
 
 class SymmetricMeanAbsolutePercentageError(Metric):
-    r"""SMAPE accumulated over batches."""
+    r"""SMAPE accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import SymmetricMeanAbsolutePercentageError
+        >>> preds = jnp.asarray([1.0, 10.0, 1e6])
+        >>> target = jnp.asarray([0.9, 15.0, 1.2e6])
+        >>> smape = SymmetricMeanAbsolutePercentageError()
+        >>> print(round(float(smape(preds, target)), 4))
+        0.229
+    """
 
     is_differentiable = True
 

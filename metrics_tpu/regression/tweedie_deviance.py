@@ -13,7 +13,17 @@ from metrics_tpu.functional.regression.tweedie_deviance import (
 
 
 class TweedieDevianceScore(Metric):
-    r"""Tweedie deviance for a given power, accumulated over batches."""
+    r"""Tweedie deviance for a given power, accumulated over batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import TweedieDevianceScore
+        >>> preds = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+        >>> target = jnp.asarray([1.5, 2.5, 3.5, 4.5])
+        >>> deviance = TweedieDevianceScore(power=1.0)
+        >>> print(round(float(deviance(preds, target)), 4))
+        0.1178
+    """
 
     is_differentiable = True
 

@@ -8,7 +8,18 @@ from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
 
 
 class RetrievalMAP(RetrievalMetric):
-    """Mean average precision over queries (vectorized over all groups)."""
+    """Mean average precision over queries (vectorized over all groups).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMAP
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> rmap = RetrievalMAP()
+        >>> print(round(float(rmap(preds, target, indexes=indexes)), 4))
+        0.9167
+    """
 
     def _segment_metric(self, g: GroupedByQuery) -> Array:
         rel = (g.target > 0).astype(jnp.float32)

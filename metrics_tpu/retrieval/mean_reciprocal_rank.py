@@ -10,7 +10,18 @@ _BIG = jnp.iinfo(jnp.int32).max
 
 
 class RetrievalMRR(RetrievalMetric):
-    """Mean reciprocal rank of the first relevant document per query."""
+    """Mean reciprocal rank of the first relevant document per query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalMRR
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> mrr = RetrievalMRR()
+        >>> print(round(float(mrr(preds, target, indexes=indexes)), 4))
+        1.0
+    """
 
     def _segment_metric(self, g: GroupedByQuery) -> Array:
         first_rel_rank = segment_min(jnp.where(g.target > 0, g.rank, _BIG), g)

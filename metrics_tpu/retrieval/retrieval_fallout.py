@@ -12,7 +12,18 @@ from metrics_tpu.utils.checks import _check_retrieval_k
 
 
 class RetrievalFallOut(RetrievalMetric):
-    """Mean fall-out@k: non-relevant retrieved / all non-relevant."""
+    """Mean fall-out@k: non-relevant retrieved / all non-relevant.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalFallOut
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> fo2 = RetrievalFallOut(k=2)
+        >>> print(round(float(fo2(preds, target, indexes=indexes)), 4))
+        0.5
+    """
 
     higher_is_better = False
     empty_on_negatives = True

@@ -11,7 +11,18 @@ from metrics_tpu.utils.checks import _check_retrieval_k
 
 
 class RetrievalNormalizedDCG(RetrievalMetric):
-    """Mean nDCG@k over queries; linear gain, log2 discount."""
+    """Mean nDCG@k over queries; linear gain, log2 discount.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalNormalizedDCG
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> ndcg = RetrievalNormalizedDCG()
+        >>> print(round(float(ndcg(preds, target, indexes=indexes)), 4))
+        0.9599
+    """
 
     allow_non_binary_target = True
 

@@ -11,7 +11,18 @@ from metrics_tpu.utils.checks import _check_retrieval_k
 
 
 class RetrievalPrecision(RetrievalMetric):
-    """Mean precision@k over queries (k=None → full group size)."""
+    """Mean precision@k over queries (k=None → full group size).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> p2 = RetrievalPrecision(k=2)
+        >>> print(round(float(p2(preds, target, indexes=indexes)), 4))
+        0.5
+    """
 
     def __init__(
         self,

@@ -11,7 +11,18 @@ from metrics_tpu.utils.checks import _check_retrieval_k
 
 
 class RetrievalRecall(RetrievalMetric):
-    """Mean recall@k over queries."""
+    """Mean recall@k over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import RetrievalRecall
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.7])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> r2 = RetrievalRecall(k=2)
+        >>> print(round(float(r2(preds, target, indexes=indexes)), 4))
+        0.75
+    """
 
     def __init__(
         self,

@@ -31,7 +31,19 @@ def _bootstrap_sampler(
 
 class BootStrapper(Metric):
     r"""Keeps ``num_bootstraps`` copies of a base metric; every update feeds
-    each copy a with-replacement resampling of the batch."""
+    each copy a with-replacement resampling of the batch.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BootStrapper, MeanSquaredError
+        >>> boot = BootStrapper(MeanSquaredError(), num_bootstraps=20, seed=0)
+        >>> boot.update(jnp.linspace(0, 1, 64), jnp.linspace(0, 1, 64) + 0.1)
+        >>> out = boot.compute()
+        >>> print(sorted(out))
+        ['mean', 'std']
+        >>> print(round(float(out["mean"]), 3))
+        0.01
+    """
 
     def __init__(
         self,

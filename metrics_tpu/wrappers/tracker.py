@@ -12,7 +12,19 @@ from metrics_tpu.core.metric import Metric
 
 
 class MetricTracker(list):
-    """Keeps one metric clone per ``increment()``; exposes best/all values."""
+    """Keeps one metric clone per ``increment()``; exposes best/all values.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy, MetricTracker
+        >>> tracker = MetricTracker(Accuracy(num_classes=3))
+        >>> for preds in ([0, 2, 1], [0, 1, 1]):
+        ...     tracker.increment()
+        ...     _ = tracker(jnp.asarray(preds), jnp.asarray([0, 1, 1]))
+        >>> best, step = tracker.best_metric(return_step=True)
+        >>> print(round(float(best), 4), int(step))
+        1.0 1
+    """
 
     def __init__(self, metric: Metric, maximize: bool = True) -> None:
         super().__init__()

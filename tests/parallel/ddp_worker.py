@@ -1,0 +1,82 @@
+"""Worker for the real 2-process DDP sync test (run via subprocess).
+
+The analogue of the reference's per-rank ``_class_test`` body
+(``tests/helpers/testers.py:104-207``): rank-strided batches, per-rank
+``update``, then ``compute()`` must equal the single-process reference over
+ALL ranks' data. Run as:
+
+    python ddp_worker.py <rank> <world> <port>
+"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+RANK, WORLD, PORT = int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3])
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{PORT}", num_processes=WORLD, process_id=RANK
+)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from sklearn.metrics import accuracy_score, roc_auc_score  # noqa: E402
+
+from metrics_tpu import AUROC, Accuracy, MeanSquaredError, PearsonCorrcoef  # noqa: E402
+
+NUM_BATCHES, BATCH, C = 6, 32, 5
+rng = np.random.RandomState(42)
+probs = rng.rand(NUM_BATCHES, BATCH, C).astype(np.float32)
+labels = rng.randint(0, C, (NUM_BATCHES, BATCH))
+bin_probs = rng.rand(NUM_BATCHES, BATCH).astype(np.float32)
+bin_labels = rng.randint(0, 2, (NUM_BATCHES, BATCH))
+x = rng.randn(NUM_BATCHES, BATCH).astype(np.float32)
+y = (0.5 * x + 0.1 * rng.randn(NUM_BATCHES, BATCH)).astype(np.float32)
+
+
+def _assert_close(ours, want, atol, what):
+    ours = float(np.asarray(ours))
+    assert abs(ours - want) <= atol, f"rank{RANK} {what}: {ours} != {want}"
+
+
+# -- sum-state metric: Accuracy -------------------------------------------
+acc = Accuracy(num_classes=C)
+for i in range(RANK, NUM_BATCHES, WORLD):
+    acc.update(jnp.asarray(probs[i]), jnp.asarray(labels[i]))
+want = accuracy_score(labels.reshape(-1), probs.argmax(-1).reshape(-1))
+_assert_close(acc.compute(), want, 1e-6, "accuracy")
+
+# -- cat-state metric with UNEVEN per-rank rows: AUROC ---------------------
+auroc = AUROC()
+for i in range(RANK, NUM_BATCHES, WORLD):
+    n = BATCH if RANK else BATCH - 7  # rank 0 contributes short batches
+    auroc.update(jnp.asarray(bin_probs[i, :n]), jnp.asarray(bin_labels[i, :n]))
+mask = np.ones((NUM_BATCHES, BATCH), bool)
+for i in range(0, NUM_BATCHES, WORLD):
+    mask[i, BATCH - 7 :] = False
+want = roc_auc_score(bin_labels[mask], bin_probs[mask])
+_assert_close(auroc.compute(), want, 1e-6, "auroc-uneven")
+
+# -- running-moment metric with pairwise merge: Pearson --------------------
+pearson = PearsonCorrcoef()
+for i in range(RANK, NUM_BATCHES, WORLD):
+    pearson.update(jnp.asarray(x[i]), jnp.asarray(y[i]))
+want = float(np.corrcoef(x.reshape(-1), y.reshape(-1))[0, 1])
+_assert_close(pearson.compute(), want, 1e-4, "pearson")
+
+# -- consistent-checkpoint pattern: sync_context + state_dict --------------
+mse = MeanSquaredError()
+mse.persistent(True)
+for i in range(RANK, NUM_BATCHES, WORLD):
+    mse.update(jnp.asarray(x[i]), jnp.asarray(y[i]))
+with mse.sync_context():
+    snap = mse.state_dict()
+want_sse = float(((x - y) ** 2).sum())
+assert abs(float(snap["sum_squared_error"]) - want_sse) < 1e-2, (
+    f"rank{RANK} ckpt: {snap['sum_squared_error']} != {want_sse}"
+)
+# after the context, local (unsynced) state is restored
+local = float(np.asarray(mse.sum_squared_error))
+assert local < want_sse, f"rank{RANK} unsync restore failed"
+
+print(f"rank{RANK} OK", flush=True)

@@ -1,0 +1,33 @@
+"""Run docstring examples as tests — the analogue of the reference's doctest
+suite (``Makefile:17-21`` runs pytest over the package with doctests on).
+
+Every ``Example:`` block in a metric docstring must execute and reproduce its
+printed output on the virtual CPU mesh.
+"""
+import doctest
+import importlib
+import pkgutil
+
+import pytest
+
+import metrics_tpu
+
+
+def _package_modules():
+    out = []
+    for info in pkgutil.walk_packages(metrics_tpu.__path__, prefix="metrics_tpu."):
+        if ".models" in info.name:  # heavy model defs hold no doctests
+            continue
+        out.append(info.name)
+    return sorted(out)
+
+
+@pytest.mark.parametrize("module_name", _package_modules())
+def test_module_doctests(module_name):
+    module = importlib.import_module(module_name)
+    results = doctest.testmod(
+        module,
+        verbose=False,
+        optionflags=doctest.NORMALIZE_WHITESPACE | doctest.ELLIPSIS,
+    )
+    assert results.failed == 0, f"{results.failed} doctest failure(s) in {module_name}"
