@@ -24,7 +24,7 @@ from metrics_tpu.utils.prints import rank_zero_warn
 _ALEX_CFG: Sequence = [
     (64, 11, 4, 2), "M3", (192, 5, 1, 2), "M3", (384, 3, 1, 1), (256, 3, 1, 1), (256, 3, 1, 1),
 ]
-_ALEX_TAPS = (0, 2, 4, 5, 6)  # conv indices whose relu output is a tap
+_ALEX_TAPS = (0, 1, 2, 3, 4)  # conv indices whose relu output is a tap (all 5)
 _VGG_CFG: Sequence = [
     (64, 3, 1, 1), (64, 3, 1, 1), "M",
     (128, 3, 1, 1), (128, 3, 1, 1), "M",
@@ -55,6 +55,8 @@ def lpips_init(net: str = "alex", key: Optional[Array] = None) -> Dict[str, Any]
     convs: List[Dict[str, Array]] = []
     cin = 3
     tap_dims = []
+    # tap indices count CONVS only (pool entries don't increment) — matches
+    # both _ALEX_TAPS and _VGG_TAPS
     conv_idx = 0
     for item in cfg:
         if isinstance(item, str):
@@ -82,8 +84,8 @@ def _tower_features(params: Dict[str, Any], x: Array, net: str) -> List[Array]:
     """Run the conv tower (NHWC) returning the tapped relu outputs."""
     cfg, taps = _tower_cfg(net)
     feats: List[Array] = []
+    # tap indices count CONVS only — see lpips_init
     conv_idx = 0
-    i = 0
     for item in cfg:
         if isinstance(item, str):
             w = 3 if item == "M3" else 2
@@ -92,7 +94,7 @@ def _tower_features(params: Dict[str, Any], x: Array, net: str) -> List[Array]:
             )
             continue
         _, _, stride, pad = item
-        p = params["convs"][i]
+        p = params["convs"][conv_idx]
         x = lax.conv_general_dilated(
             x, p["kernel"], window_strides=(stride, stride),
             padding=((pad, pad), (pad, pad)),
@@ -101,7 +103,6 @@ def _tower_features(params: Dict[str, Any], x: Array, net: str) -> List[Array]:
         x = jax.nn.relu(x)
         if conv_idx in taps:
             feats.append(x)
-        i += 1
         conv_idx += 1
     return feats
 
