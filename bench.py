@@ -38,10 +38,12 @@ def _ensure_backend(probe_timeout: int = 240, attempts: int = 2) -> str:
     platform name actually in use.
     """
     plats = os.environ.get("JAX_PLATFORMS", "")
-    if plats in ("", "cpu"):
+    if plats == "cpu":
         import jax
 
         return jax.devices()[0].platform
+    # empty JAX_PLATFORMS still auto-detects accelerator plugins, so it gets
+    # the same timeout-guarded probe as an explicit accelerator setting
 
     code = "import jax; d = jax.devices(); print('PROBE_OK', d[0].platform)"
     last_err = None
@@ -193,6 +195,7 @@ def bench_config2() -> None:
     # warmup steps already consumed rows, so the timed loop takes the rest —
     # derived from capacity so changing WARM cannot overflow the CatBuffer.
     steps = steps_cap - WARM - 1
+    assert steps > 0, f"WARM={WARM} leaves no timed steps for capacity {steps_cap}"
     dt = _time_steps(loop, preds, target, steps=steps, warm=WARM)
     val = mc.pure_compute(holder["s"])
     n_rows = int(np.asarray(holder["s"]["auroc"]["preds"].count))

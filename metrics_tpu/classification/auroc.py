@@ -10,7 +10,11 @@ from jax import Array
 from metrics_tpu.core.cat_buffer import CatBuffer
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.functional.classification.auroc import _auroc_compute, _auroc_update
-from metrics_tpu.ops.ranking import masked_binary_auroc
+from metrics_tpu.ops.ranking import (
+    masked_binary_auroc,
+    masked_multiclass_auroc,
+    masked_multilabel_auroc,
+)
 from metrics_tpu.utils.data import dim_zero_cat
 from metrics_tpu.utils.enums import AverageMethod, DataType
 
@@ -83,17 +87,36 @@ class AUROC(Metric):
         # eager-only). Identical value incl. tie handling, except the
         # degenerate single-class case: the curve path raises eagerly, this
         # path (which cannot raise under jit) returns the uninformative 0.5.
-        if (
-            isinstance(self._state["preds"], CatBuffer)
-            and self.mode == DataType.BINARY
-            and self.max_fpr is None
-            and self.pos_label in (None, 1)
-        ):
+        if isinstance(self._state["preds"], CatBuffer) and self.max_fpr is None:
             preds_cb: CatBuffer = self._state["preds"]
             target_cb: CatBuffer = self._state["target"]
-            if preds_cb.buffer is None:
-                raise ValueError("No samples to concatenate")
-            return masked_binary_auroc(preds_cb.buffer, target_cb.buffer, preds_cb.mask())
+            if self.mode == DataType.BINARY and self.pos_label in (None, 1):
+                if preds_cb.buffer is None:
+                    raise ValueError("No samples to concatenate")
+                return masked_binary_auroc(preds_cb.buffer, target_cb.buffer, preds_cb.mask())
+            # one-vs-rest vectorized masked path: multiclass [N, C] scores vs
+            # int targets, multilabel [N, C] vs [N, C] — one vmapped XLA
+            # program (mdmc rows were already flattened to [N*X, C] by
+            # _auroc_update)
+            if (
+                preds_cb.buffer is not None
+                and preds_cb.buffer.ndim == 2
+                and self.average != "micro"
+                and self.mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
+                and target_cb.buffer.ndim == 1
+            ):
+                return masked_multiclass_auroc(
+                    preds_cb.buffer, target_cb.buffer, preds_cb.mask(), self.average
+                )
+            if (
+                preds_cb.buffer is not None
+                and preds_cb.buffer.ndim == 2
+                and self.mode == DataType.MULTILABEL
+                and target_cb.buffer.ndim == 2
+            ):
+                return masked_multilabel_auroc(
+                    preds_cb.buffer, target_cb.buffer, preds_cb.mask(), self.average
+                )
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _auroc_compute(

@@ -14,7 +14,10 @@ from metrics_tpu.functional.classification.average_precision import (
     _average_precision_compute,
     _average_precision_update,
 )
-from metrics_tpu.ops.ranking import masked_binary_average_precision
+from metrics_tpu.ops.ranking import (
+    masked_binary_average_precision,
+    masked_multiclass_average_precision,
+)
 from metrics_tpu.utils.data import dim_zero_cat
 
 
@@ -72,21 +75,35 @@ class AveragePrecision(Metric):
         # segment sums (ops/ranking.py) — update + sync + compute fuse into
         # one jitted program; the curve path needs data-dependent
         # unique-threshold sizes and is eager-only. Same value incl. ties.
-        if (
-            isinstance(self._state["preds"], CatBuffer)
-            and self.num_classes == 1
-            and self.pos_label == 1
-        ):
+        if isinstance(self._state["preds"], CatBuffer):
             preds_cb: CatBuffer = self._state["preds"]
             target_cb: CatBuffer = self._state["target"]
-            if preds_cb.buffer is None:
-                raise ValueError("No samples to concatenate")
-            # binarize exactly like the curve path (`target == pos_label` in
-            # `_binary_clf_curve`) — raw targets may hold values outside {0,1}
-            binary_target = (target_cb.buffer == self.pos_label).astype(jnp.float32)
-            return masked_binary_average_precision(
-                preds_cb.buffer, binary_target, preds_cb.mask()
-            )
+            if self.num_classes == 1 and self.pos_label == 1:
+                if preds_cb.buffer is None:
+                    raise ValueError("No samples to concatenate")
+                # binarize exactly like the curve path (`target == pos_label` in
+                # `_binary_clf_curve`) — raw targets may hold values outside {0,1}
+                binary_target = (target_cb.buffer == self.pos_label).astype(jnp.float32)
+                return masked_binary_average_precision(
+                    preds_cb.buffer, binary_target, preds_cb.mask()
+                )
+            # one-vs-rest vectorized masked path for multiclass [N, C] scores:
+            # per-class AP under one vmap, NaN classes excluded from the
+            # average like the eager path's nan-filter
+            if (
+                preds_cb.buffer is not None
+                and preds_cb.buffer.ndim == 2
+                and target_cb.buffer.ndim == 1
+                and self.average != "micro"
+            ):
+                res = masked_multiclass_average_precision(
+                    preds_cb.buffer, target_cb.buffer, preds_cb.mask(), self.average
+                )
+                if self.average is None:
+                    # list-of-scalars like the eager path, so the return type
+                    # doesn't flip with with_capacity()
+                    return [res[c] for c in range(preds_cb.buffer.shape[1])]
+                return res
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
         return _average_precision_compute(

@@ -1,0 +1,229 @@
+"""masked multiclass/multilabel AUROC + AP — one-vs-rest vectorized,
+static-shape (ops/ranking.py), so CatBuffer-mode multiclass curve metrics
+fuse update → all_gather sync → compute into ONE jitted XLA program.
+
+Parity references: per-class sklearn roc_auc_score / average_precision_score
+composed exactly like the reference's eager multiclass paths
+(``functional/classification/auroc.py:120-257``,
+``average_precision.py:37-86``).
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+from sklearn.metrics import average_precision_score, roc_auc_score
+
+from metrics_tpu import AUROC, AveragePrecision
+from metrics_tpu.ops.ranking import (
+    masked_multiclass_auroc,
+    masked_multiclass_average_precision,
+    masked_multilabel_auroc,
+)
+
+rng = np.random.RandomState(33)
+NUM_CLASSES = 5
+
+
+def _mc_data(n, num_classes=NUM_CLASSES, quantized=False):
+    p = rng.rand(n, num_classes).astype(np.float32)
+    if quantized:  # heavy ties
+        p = np.round(p * 4) / 4.0
+    p = p / p.sum(1, keepdims=True)
+    t = rng.randint(0, num_classes, n)
+    return p, t
+
+
+def _sk_ovr_auroc(p, t, average, num_classes=NUM_CLASSES):
+    scores = np.array([roc_auc_score((t == c).astype(int), p[:, c]) for c in range(num_classes)])
+    if average is None:
+        return scores
+    if average == "macro":
+        return scores.mean()
+    support = np.bincount(t, minlength=num_classes)
+    return (scores * support).sum() / support.sum()
+
+
+@pytest.mark.parametrize("average", [None, "macro", "weighted"])
+@pytest.mark.parametrize("quantized", [False, True])
+def test_multiclass_auroc_parity(average, quantized):
+    p, t = _mc_data(400, quantized=quantized)
+    got = np.asarray(masked_multiclass_auroc(jnp.asarray(p), jnp.asarray(t), average=average))
+    np.testing.assert_allclose(got, _sk_ovr_auroc(p, t, average), atol=1e-6)
+
+
+def test_multiclass_auroc_mask_equals_slice():
+    p, t = _mc_data(300)
+    mask = np.arange(300) < 120
+    got = float(
+        masked_multiclass_auroc(jnp.asarray(p), jnp.asarray(t), jnp.asarray(mask), "macro")
+    )
+    np.testing.assert_allclose(got, _sk_ovr_auroc(p[:120], t[:120], "macro"), atol=1e-6)
+
+
+def test_multiclass_auroc_weighted_drops_unobserved_class():
+    """A class with zero support contributes nothing under `weighted` —
+    the static-shape analogue of the reference's column drop."""
+    p, t = _mc_data(200, num_classes=4)
+    t = np.where(t == 3, 0, t)  # class 3 never observed
+    got = float(
+        masked_multiclass_auroc(jnp.asarray(p), jnp.asarray(t), average="weighted")
+    )
+    scores = [roc_auc_score((t == c).astype(int), p[:, c]) for c in range(3)]
+    support = np.bincount(t, minlength=4)[:3]
+    np.testing.assert_allclose(got, (scores * support).sum() / support.sum(), atol=1e-6)
+
+
+@pytest.mark.parametrize("average", [None, "macro", "weighted", "micro"])
+def test_multilabel_auroc_parity(average):
+    n, c = 300, 4
+    p = rng.rand(n, c).astype(np.float32)
+    t = rng.randint(0, 2, (n, c))
+    got = np.asarray(
+        masked_multilabel_auroc(jnp.asarray(p), jnp.asarray(t), average=average)
+    )
+    if average == "micro":
+        exp = roc_auc_score(t.reshape(-1), p.reshape(-1))
+    else:
+        scores = np.array([roc_auc_score(t[:, i], p[:, i]) for i in range(c)])
+        if average is None:
+            exp = scores
+        elif average == "macro":
+            exp = scores.mean()
+        else:
+            support = t.sum(0)
+            exp = (scores * support).sum() / support.sum()
+    np.testing.assert_allclose(got, exp, atol=1e-6)
+
+
+@pytest.mark.parametrize("average", [None, "macro", "weighted"])
+def test_multiclass_average_precision_parity(average):
+    p, t = _mc_data(400)
+    got = np.asarray(
+        masked_multiclass_average_precision(jnp.asarray(p), jnp.asarray(t), average=average)
+    )
+    scores = np.array(
+        [average_precision_score((t == c).astype(int), p[:, c]) for c in range(NUM_CLASSES)]
+    )
+    if average is None:
+        exp = scores
+    elif average == "macro":
+        exp = scores.mean()
+    else:
+        support = np.bincount(t, minlength=NUM_CLASSES)
+        exp = (scores * support / support.sum()).sum()
+    np.testing.assert_allclose(got, exp, atol=1e-6)
+
+
+def test_multiclass_ap_nan_class_excluded_from_macro():
+    """No valid positives for a class → per-class NaN, excluded from macro
+    (reference `_average_precision_compute_with_precision_recall` nan-filter)."""
+    p, t = _mc_data(200, num_classes=4)
+    t = np.where(t == 2, 1, t)  # class 2 unobserved
+    got_vec = np.asarray(
+        masked_multiclass_average_precision(jnp.asarray(p), jnp.asarray(t), average=None)
+    )
+    assert np.isnan(got_vec[2]) and not np.isnan(np.delete(got_vec, 2)).any()
+    got = float(
+        masked_multiclass_average_precision(jnp.asarray(p), jnp.asarray(t), average="macro")
+    )
+    exp = np.nanmean(
+        [average_precision_score((t == c).astype(int), p[:, c]) if (t == c).any() else np.nan
+         for c in range(4)]
+    )
+    np.testing.assert_allclose(got, exp, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# module (CatBuffer) integration
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted"])
+def test_catbuffer_multiclass_auroc_matches_list_mode(average):
+    p, t = _mc_data(10 * 32)
+    p, t = p.reshape(10, 32, NUM_CLASSES), t.reshape(10, 32)
+    m_list = AUROC(num_classes=NUM_CLASSES, average=average)
+    m_cb = AUROC(num_classes=NUM_CLASSES, average=average).with_capacity(512)
+    for i in range(10):
+        m_list.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+        m_cb.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    np.testing.assert_allclose(float(m_cb.compute()), float(m_list.compute()), atol=1e-6)
+    np.testing.assert_allclose(
+        float(m_cb.compute()),
+        _sk_ovr_auroc(p.reshape(-1, NUM_CLASSES), t.reshape(-1), average),
+        atol=1e-6,
+    )
+
+
+def test_catbuffer_multiclass_ap_matches_sklearn():
+    p, t = _mc_data(8 * 32)
+    p, t = p.reshape(8, 32, NUM_CLASSES), t.reshape(8, 32)
+    m_cb = AveragePrecision(num_classes=NUM_CLASSES, average="macro").with_capacity(512)
+    for i in range(8):
+        m_cb.update(jnp.asarray(p[i]), jnp.asarray(t[i]))
+    flat_p, flat_t = p.reshape(-1, NUM_CLASSES), t.reshape(-1)
+    exp = np.mean(
+        [average_precision_score((flat_t == c).astype(int), flat_p[:, c])
+         for c in range(NUM_CLASSES)]
+    )
+    np.testing.assert_allclose(float(m_cb.compute()), exp, atol=1e-6)
+
+
+def test_catbuffer_multiclass_ap_average_none_returns_list():
+    """Return type must not flip with with_capacity(): eager returns a
+    per-class list, so the CatBuffer path does too."""
+    p, t = _mc_data(64)
+    m = AveragePrecision(num_classes=NUM_CLASSES, average=None).with_capacity(64)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    res = m.compute()
+    assert isinstance(res, list) and len(res) == NUM_CLASSES
+    exp = [average_precision_score((t == c).astype(int), p[:, c]) for c in range(NUM_CLASSES)]
+    np.testing.assert_allclose([float(r) for r in res], exp, atol=1e-6)
+
+
+def test_fused_multiclass_auroc_jitted():
+    """update + compute both trace — the whole pipeline is one XLA program."""
+    m = AUROC(num_classes=NUM_CLASSES).with_capacity(320)
+    p, t = _mc_data(10 * 32)
+    p, t = p.reshape(10, 32, NUM_CLASSES), t.reshape(10, 32)
+    m.update(jnp.asarray(p[0]), jnp.asarray(t[0]))
+    m.reset()
+    step = jax.jit(m.pure_update)
+    state = m.init_state()
+    for i in range(10):
+        state = step(state, jnp.asarray(p[i]), jnp.asarray(t[i]))
+    val = jax.jit(m.pure_compute)(state)
+    np.testing.assert_allclose(
+        float(val), _sk_ovr_auroc(p.reshape(-1, NUM_CLASSES), t.reshape(-1), "macro"),
+        atol=1e-6,
+    )
+
+
+def test_fully_fused_sharded_multiclass_pipeline():
+    """Multiclass CatBuffer AUROC: per-device update, all_gather sync,
+    vmapped one-vs-rest compute — ONE jitted program over the mesh."""
+    world, per_rank, bs = 4, 2, 32
+    p, t = _mc_data(world * per_rank * bs)
+    p = p.reshape(world, per_rank, bs, NUM_CLASSES)
+    t = t.reshape(world, per_rank, bs)
+    mesh = Mesh(np.array(jax.devices()[:world]), ("dp",))
+    m = AUROC(num_classes=NUM_CLASSES).with_capacity(per_rank * bs)
+    m.update(jnp.asarray(p[0, 0]), jnp.asarray(t[0, 0]))
+    m.reset()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+    def fused(p_sh, t_sh):
+        st = m.init_state()
+        for i in range(per_rank):
+            st = m.pure_update(st, p_sh[0, i], t_sh[0, i])
+        synced = m.pure_sync(st, "dp")
+        return m.pure_compute(synced)
+
+    out = jax.jit(fused)(jnp.asarray(p), jnp.asarray(t))
+    np.testing.assert_allclose(
+        float(out), _sk_ovr_auroc(p.reshape(-1, NUM_CLASSES), t.reshape(-1), "macro"),
+        atol=1e-6,
+    )
