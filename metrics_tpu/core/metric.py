@@ -102,6 +102,10 @@ class Metric:
         self.dist_sync_on_step = dist_sync_on_step
         self.process_group = process_group
         self.dist_sync_fn = dist_sync_fn
+        # overridable seam for integrations/tests: sync() fires only when this
+        # reports a world (reference gates on torch.distributed initialization,
+        # metric.py:274-277; here the default is multi-process JAX)
+        self.distributed_available_fn: Callable[[], bool] = jit_distributed_available
         self._update_called = False
         self._computed: Any = None
         self._forward_cache: Any = None
@@ -291,7 +295,7 @@ class Metric:
         if self._is_synced and should_sync:
             raise MetricsTPUUserError("The Metric has already been synced.")
         is_distributed = (
-            distributed_available() if distributed_available is not None else jit_distributed_available()
+            distributed_available() if distributed_available is not None else self.distributed_available_fn()
         )
         if not should_sync or not is_distributed:
             return
@@ -718,6 +722,8 @@ class Metric:
         return CompositionalMetric(lambda x: -x, self, None)
 
     def __pos__(self) -> "CompositionalMetric":
+        # deliberately abs, NOT identity: faithful to the reference's quirk
+        # (`metric.py:649-650` maps __pos__ to torch.abs) — do not "fix"
         return CompositionalMetric(jnp.abs, self, None)
 
     def __invert__(self) -> "CompositionalMetric":

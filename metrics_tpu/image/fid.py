@@ -16,15 +16,23 @@ Key redesigns vs the reference:
 """
 from typing import Any, Callable, Optional, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.models.inception import InceptionFeatureExtractor
-from metrics_tpu.ops.linalg import trace_sqrtm_product
+from metrics_tpu.ops.linalg import kahan_add, trace_sqrtm_product
 from metrics_tpu.utils.data import dim_zero_cat
 
-_HIGH = jnp.float64  # silently float32 unless jax x64 is enabled
+def _high_dtype():
+    """Moment dtype — explicit precision story (reference computes covariance
+    in real float64, ``fid.py:269-272``; TPU f64 is software-emulated and
+    slow): float64 when the user has enabled jax x64 *at call time*, float32
+    otherwise — the float32 path is precision-rescued with Kahan-compensated
+    streaming sums, validated at the reference's atol=1e-3 vs scipy
+    (tests/image/test_fid_precision.py). canonicalize_dtype never warns."""
+    return jax.dtypes.canonicalize_dtype(jnp.float64)
 
 
 def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
@@ -44,9 +52,17 @@ def _mean_cov(features: Array) -> Tuple[Array, Array]:
     return mean, cov
 
 
-def _stats_to_mean_cov(s: Array, ss: Array, n: Array) -> Tuple[Array, Array]:
-    mean = s / n
-    cov = (ss - n * jnp.outer(mean, mean)) / (n - 1)
+def _stats_to_mean_cov(
+    s: Array, s_comp: Array, ss: Array, ss_comp: Array, n: Array
+) -> Tuple[Array, Array]:
+    """Mean/covariance from Kahan-compensated sufficient statistics.
+
+    The compensation terms fold back in here (``sum - comp`` is the corrected
+    total: Kahan's comp holds the negated lost low-order bits)."""
+    total = s - s_comp
+    total_outer = ss - ss_comp
+    mean = total / n
+    cov = (total_outer - n * jnp.outer(mean, mean)) / (n - 1)
     return mean, cov
 
 
@@ -95,11 +111,17 @@ class FID(Metric):
                     " `feature` tap or `feature_dim=` alongside a callable."
                 )
             for side in ("real", "fake"):
-                self.add_state(f"{side}_sum", jnp.zeros((feat_dim,), dtype=_HIGH), dist_reduce_fx="sum")
+                self.add_state(f"{side}_sum", jnp.zeros((feat_dim,), dtype=_high_dtype()), dist_reduce_fx="sum")
                 self.add_state(
-                    f"{side}_outer", jnp.zeros((feat_dim, feat_dim), dtype=_HIGH), dist_reduce_fx="sum"
+                    f"{side}_outer", jnp.zeros((feat_dim, feat_dim), dtype=_high_dtype()), dist_reduce_fx="sum"
                 )
-                self.add_state(f"{side}_n", jnp.zeros((), dtype=_HIGH), dist_reduce_fx="sum")
+                # Kahan compensation companions — rescue f32 streaming sums
+                # over long eval runs; psum composes (comps add per device)
+                self.add_state(f"{side}_sum_comp", jnp.zeros((feat_dim,), dtype=_high_dtype()), dist_reduce_fx="sum")
+                self.add_state(
+                    f"{side}_outer_comp", jnp.zeros((feat_dim, feat_dim), dtype=_high_dtype()), dist_reduce_fx="sum"
+                )
+                self.add_state(f"{side}_n", jnp.zeros((), dtype=_high_dtype()), dist_reduce_fx="sum")
         else:
             self.add_state("real_features", [], dist_reduce_fx=None)
             self.add_state("fake_features", [], dist_reduce_fx=None)
@@ -107,10 +129,18 @@ class FID(Metric):
     def update(self, imgs: Array, real: bool) -> None:  # type: ignore[override]
         features = self.inception(imgs)
         if self.streaming:
-            f = features.astype(_HIGH)
+            f = features.astype(_high_dtype())
             side = "real" if real else "fake"
-            setattr(self, f"{side}_sum", getattr(self, f"{side}_sum") + f.sum(axis=0))
-            setattr(self, f"{side}_outer", getattr(self, f"{side}_outer") + f.T @ f)
+            s, c = kahan_add(
+                getattr(self, f"{side}_sum"), getattr(self, f"{side}_sum_comp"), f.sum(axis=0)
+            )
+            setattr(self, f"{side}_sum", s)
+            setattr(self, f"{side}_sum_comp", c)
+            ss, cc = kahan_add(
+                getattr(self, f"{side}_outer"), getattr(self, f"{side}_outer_comp"), f.T @ f
+            )
+            setattr(self, f"{side}_outer", ss)
+            setattr(self, f"{side}_outer_comp", cc)
             setattr(self, f"{side}_n", getattr(self, f"{side}_n") + f.shape[0])
         elif real:
             self.real_features.append(features)
@@ -121,11 +151,15 @@ class FID(Metric):
         """FID over all accumulated features (reference ``fid.py:265-284``);
         moments in the highest available precision."""
         if self.streaming:
-            mean1, cov1 = _stats_to_mean_cov(self.real_sum, self.real_outer, self.real_n)
-            mean2, cov2 = _stats_to_mean_cov(self.fake_sum, self.fake_outer, self.fake_n)
+            mean1, cov1 = _stats_to_mean_cov(
+                self.real_sum, self.real_sum_comp, self.real_outer, self.real_outer_comp, self.real_n
+            )
+            mean2, cov2 = _stats_to_mean_cov(
+                self.fake_sum, self.fake_sum_comp, self.fake_outer, self.fake_outer_comp, self.fake_n
+            )
         else:
-            real = dim_zero_cat(self.real_features).astype(_HIGH)
-            fake = dim_zero_cat(self.fake_features).astype(_HIGH)
+            real = dim_zero_cat(self.real_features).astype(_high_dtype())
+            fake = dim_zero_cat(self.fake_features).astype(_high_dtype())
             mean1, cov1 = _mean_cov(real)
             mean2, cov2 = _mean_cov(fake)
         return _compute_fid(mean1, cov1, mean2, cov2).astype(jnp.float32)

@@ -11,6 +11,21 @@ import jax.numpy as jnp
 from jax import Array, lax
 
 
+def kahan_add(total: Array, comp: Array, value: Array) -> Tuple[Array, Array]:
+    """One Kahan-compensated accumulation step: returns ``(total', comp')``.
+
+    Precision rescue for float32 streaming sums (SURVEY §7): the compensation
+    term carries the roundoff lost by ``total + value``, so a long stream of
+    batch statistics keeps ~2x the mantissa. Works under jit — XLA does not
+    reassociate float arithmetic. Both terms are plain "sum" states, so
+    cross-device ``psum`` composes: per-device compensations add.
+    """
+    y = value - comp
+    t = total + y
+    comp_new = (t - total) - y
+    return t, comp_new
+
+
 def sqrtm_newton_schulz(mat: Array, num_iters: int = 25) -> Array:
     """Matrix square root of a symmetric PSD matrix via Newton–Schulz.
 

@@ -42,7 +42,12 @@ def _ceil_to(x: int, m: int) -> int:
 
 
 def _binned_stats_xla(preds: Array, target: Array, thresholds: Array) -> Tuple[Array, Array, Array]:
-    """Fused-XLA reference path: broadcast compare + reduce (CPU default)."""
+    """Fused-XLA reference path: broadcast compare + reduce (CPU default).
+
+    Compares in float32 like the pallas kernel does, so inputs lying exactly
+    at a threshold classify identically on both backends."""
+    preds = preds.astype(jnp.float32)
+    thresholds = thresholds.astype(jnp.float32)
     predictions = preds[:, :, None] >= thresholds[None, None, :]
     t = target[:, :, None].astype(bool)
     tp = jnp.sum(t & predictions, axis=0).astype(jnp.float32)

@@ -1,0 +1,21 @@
+"""Deprecated import-path alias for :class:`R2Score`.
+
+Parity shim for the reference's ``torchmetrics/regression/r2score.py``
+(deprecated in its v0.5: ``r2score`` renamed ``r2_score``): importing from
+this module warns once and hands back the real class.
+"""
+from typing import Any
+
+from metrics_tpu.regression.r2 import R2Score as _R2Score
+from metrics_tpu.utils.prints import rank_zero_deprecation
+
+
+class R2Score(_R2Score):
+    """Deprecated alias of :class:`metrics_tpu.regression.r2.R2Score`."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        rank_zero_deprecation(
+            "`metrics_tpu.regression.r2score.R2Score` is a deprecated alias;"
+            " import `R2Score` from `metrics_tpu` instead."
+        )
+        super().__init__(*args, **kwargs)

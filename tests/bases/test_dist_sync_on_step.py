@@ -1,0 +1,118 @@
+"""Per-step DDP sync semantics (``dist_sync_on_step=True``).
+
+Mirror of the reference's per-step assertion (``tests/helpers/testers.py:
+172-181``): a rank's ``forward`` at step *s* must return the metric computed
+over the concatenation of ALL ranks' step-*s* batches, while accumulation
+stays local. Ranks are simulated with injected ``dist_sync_fn`` gathers —
+the same seam Lightning uses (reference ``metric.py:78``).
+"""
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score, mean_squared_error, roc_auc_score
+
+from metrics_tpu import AUROC, Accuracy, ConfusionMatrix, MeanSquaredError
+
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester, THRESHOLD
+
+rng = np.random.RandomState(44)
+
+
+class TestDistSyncOnStepAccuracy(MetricTester):
+    def test_accuracy_per_step_sync(self):
+        preds = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+        target = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+        self.run_class_metric_test(
+            ddp=True,
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=lambda p, t: accuracy_score(t, (p >= THRESHOLD).astype(int)),
+            dist_sync_on_step=True,
+        )
+
+
+class TestDistSyncOnStepMSE(MetricTester):
+    atol = 1e-6
+
+    def test_mse_per_step_sync(self):
+        preds = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+        target = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+        self.run_class_metric_test(
+            ddp=True,
+            preds=preds,
+            target=target,
+            metric_class=MeanSquaredError,
+            sk_metric=mean_squared_error,
+            dist_sync_on_step=True,
+        )
+
+
+class TestDistSyncOnStepAUROC(MetricTester):
+    atol = 1e-6
+
+    def test_auroc_cat_state_per_step_sync(self):
+        """Cat-list states gather in rank order before the per-step compute."""
+        preds = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+        target = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+        target[:, 0] = 0  # both classes present in every gathered group
+        target[:, 1] = 1
+        self.run_class_metric_test(
+            ddp=True,
+            preds=preds,
+            target=target,
+            metric_class=AUROC,
+            sk_metric=lambda p, t: roc_auc_score(t, p),
+            dist_sync_on_step=True,
+        )
+
+
+class TestDistSyncOnStepConfusionMatrix(MetricTester):
+    def test_confmat_per_step_sync(self):
+        from sklearn.metrics import confusion_matrix
+
+        preds = rng.randint(0, 3, (NUM_BATCHES, BATCH_SIZE))
+        target = rng.randint(0, 3, (NUM_BATCHES, BATCH_SIZE))
+        self.run_class_metric_test(
+            ddp=True,
+            preds=preds,
+            target=target,
+            metric_class=ConfusionMatrix,
+            sk_metric=lambda p, t: confusion_matrix(t, p, labels=[0, 1, 2]),
+            dist_sync_on_step=True,
+            metric_args={"num_classes": 3},
+        )
+
+
+def test_forward_accumulation_stays_local():
+    """dist_sync_on_step syncs only the per-step value: after the loop, each
+    rank's accumulated state covers just its own batches."""
+    preds = rng.rand(4, BATCH_SIZE).astype(np.float32)
+    target = rng.randint(0, 2, (4, BATCH_SIZE))
+    import jax.numpy as jnp
+
+    from tests.helpers.testers import _gather_states
+
+    m0 = Accuracy(dist_sync_on_step=True)
+    m1 = Accuracy(dist_sync_on_step=True)
+    for i in range(0, 4, 2):
+        scratch = Accuracy()
+        scratch.update(jnp.asarray(preds[i + 1]), jnp.asarray(target[i + 1]))
+        other_state = dict(scratch._state)
+
+        def gather(state, reductions):
+            return _gather_states([state, other_state], reductions)
+
+        m0.dist_sync_fn = gather
+        m0.distributed_available_fn = lambda: True
+        m0(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        m1.update(jnp.asarray(preds[i + 1]), jnp.asarray(target[i + 1]))
+    m0.dist_sync_fn = None
+    m0.distributed_available_fn = lambda: False
+    # rank 0 accumulated ONLY batches 0 and 2
+    own = np.concatenate([preds[0], preds[2]]), np.concatenate([target[0], target[2]])
+    exp = accuracy_score(own[1], (own[0] >= THRESHOLD).astype(int))
+    np.testing.assert_allclose(float(m0.compute()), exp, atol=1e-6)
+    # the non-syncing rank's accumulation stayed local too (batches 1 and 3)
+    own1 = np.concatenate([preds[1], preds[3]]), np.concatenate([target[1], target[3]])
+    exp1 = accuracy_score(own1[1], (own1[0] >= THRESHOLD).astype(int))
+    np.testing.assert_allclose(float(m1.compute()), exp1, atol=1e-6)

@@ -57,6 +57,30 @@ def _concat_rank_data(x: np.ndarray, world: int, rank: int) -> np.ndarray:
     return np.concatenate([x[i] for i in range(rank, x.shape[0], world)], axis=0)
 
 
+def _gather_states(states: Sequence[Dict[str, Any]], reductions: Dict[str, Any]) -> Dict[str, Any]:
+    """Rank-ordered gather+reduce of per-rank state dicts — the tester's
+    stand-in for the reference's ``gather_all_tensors`` + reduction
+    (``metric.py:217-242``). Used as an injected ``dist_sync_fn``."""
+    out: Dict[str, Any] = {}
+    for name, red in reductions.items():
+        vals = [s[name] for s in states]
+        if isinstance(vals[0], list):  # cat-list state: concat in rank order
+            out[name] = [x for v in vals for x in v]
+        elif red == "sum":
+            out[name] = sum(vals[1:], vals[0])
+        elif red == "mean":
+            out[name] = sum(vals[1:], vals[0]) / len(vals)
+        elif red == "cat":
+            out[name] = jnp.concatenate([jnp.asarray(v) for v in vals], axis=0)
+        elif callable(red):
+            out[name] = red(jnp.stack([jnp.asarray(v) for v in vals]))
+        elif red is None:
+            out[name] = list(vals)
+        else:
+            raise NotImplementedError(f"_gather_states: unsupported reduction {red!r}")
+    return out
+
+
 def _with_static_num_classes(
     metric_class: type, metric_args: dict, preds: np.ndarray, target: np.ndarray
 ) -> dict:
@@ -127,18 +151,56 @@ class MetricTester:
         metric_args = metric_args or {}
         world = NUM_PROCESSES if ddp else 1
 
-        metrics = [metric_class(**metric_args) for _ in range(world)]
+        metrics = [
+            metric_class(**metric_args, dist_sync_on_step=dist_sync_on_step)
+            for _ in range(world)
+        ]
         # pickle gate (reference testers.py:163-165)
         metrics[0] = _pickle_roundtrip(metrics[0])
 
-        for i in range(NUM_BATCHES):
-            rank = i % world
-            batch_result = metrics[rank](
-                jnp.asarray(preds[i]), jnp.asarray(target[i]), **{k: jnp.asarray(v[i]) for k, v in kwargs_update.items()}
-            )
-            if check_batch and not dist_sync_on_step:
-                sk_batch_result = sk_metric(preds[i], target[i])
-                _assert_allclose(batch_result, sk_batch_result, atol=self.atol)
+        if ddp and dist_sync_on_step:
+            # per-step sync semantics (reference testers.py:172-181): every
+            # rank's forward at step s must equal the reference on the
+            # concatenation of ALL ranks' step-s batches. Each rank's
+            # dist_sync_fn gathers the other ranks' batch states in rank order.
+            assert NUM_BATCHES % world == 0
+            for i in range(0, NUM_BATCHES, world):
+                kw_i = lambda j: {k: jnp.asarray(v[j]) for k, v in kwargs_update.items()}  # noqa: E731
+                batch_states = []
+                for r in range(world):
+                    scratch = metric_class(**metric_args)
+                    scratch.update(jnp.asarray(preds[i + r]), jnp.asarray(target[i + r]), **kw_i(i + r))
+                    batch_states.append(dict(scratch._state))
+                for r in range(world):
+                    m = metrics[r]
+
+                    def gather(state, reductions, _r=r):
+                        ordered = [
+                            state if r2 == _r else batch_states[r2] for r2 in range(world)
+                        ]
+                        return _gather_states(ordered, reductions)
+
+                    m.dist_sync_fn = gather
+                    m.distributed_available_fn = lambda: True
+                    batch_result = m(
+                        jnp.asarray(preds[i + r]), jnp.asarray(target[i + r]), **kw_i(i + r)
+                    )
+                    if check_dist_sync_on_step:
+                        group_preds = np.concatenate([preds[i + r2] for r2 in range(world)], axis=0)
+                        group_target = np.concatenate([target[i + r2] for r2 in range(world)], axis=0)
+                        _assert_allclose(batch_result, sk_metric(group_preds, group_target), atol=self.atol)
+            for m in metrics:  # final compute below uses the merge path
+                m.dist_sync_fn = None
+                m.distributed_available_fn = lambda: False
+        else:
+            for i in range(NUM_BATCHES):
+                rank = i % world
+                batch_result = metrics[rank](
+                    jnp.asarray(preds[i]), jnp.asarray(target[i]), **{k: jnp.asarray(v[i]) for k, v in kwargs_update.items()}
+                )
+                if check_batch and not dist_sync_on_step:
+                    sk_batch_result = sk_metric(preds[i], target[i])
+                    _assert_allclose(batch_result, sk_batch_result, atol=self.atol)
 
         total_preds = np.concatenate([preds[i] for i in range(NUM_BATCHES)], axis=0)
         total_target = np.concatenate([target[i] for i in range(NUM_BATCHES)], axis=0)

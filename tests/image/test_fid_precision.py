@@ -1,0 +1,120 @@
+"""FID precision story: f32 streaming moments with Kahan compensation must
+match a float64 scipy reference at the reference's tolerance (atol=1e-3,
+``/root/reference`` ``tests/image/test_fid.py:28-40``) — including on
+ill-conditioned covariances and long streams — and must not spew
+float64-truncation warnings (round-1 VERDICT item 7).
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import linalg as scipy_linalg
+
+from metrics_tpu import FID
+from metrics_tpu.ops.linalg import kahan_add, trace_sqrtm_product
+
+
+def _np_fid_f64(real: np.ndarray, fake: np.ndarray) -> float:
+    r = real.astype(np.float64)
+    f = fake.astype(np.float64)
+    mu1, mu2 = r.mean(0), f.mean(0)
+    c1 = np.cov(r, rowvar=False)
+    c2 = np.cov(f, rowvar=False)
+    covmean = scipy_linalg.sqrtm(c1 @ c2)
+    if np.iscomplexobj(covmean):
+        covmean = covmean.real
+    return float(((mu1 - mu2) ** 2).sum() + np.trace(c1 + c2 - 2.0 * covmean))
+
+
+def _ill_conditioned_features(rng, n, d, mean_scale=30.0):
+    """Features with a large common offset and variances spanning ~5 decades —
+    the cancellation-prone regime for E[xx^T] - mu mu^T in f32."""
+    stds = np.logspace(-2.5, 1.0, d)
+    mean = mean_scale * (1.0 + rng.rand(d))
+    return (mean + stds * rng.randn(n, d)).astype(np.float32)
+
+
+def test_streaming_fid_matches_scipy_f64_ill_conditioned():
+    rng = np.random.RandomState(0)
+    d, n, batch = 12, 20_000, 100
+    real = _ill_conditioned_features(rng, n, d)
+    fake = _ill_conditioned_features(rng, n, d, mean_scale=30.5)
+
+    feat = lambda x: x  # noqa: E731 — feed features directly
+    fid = FID(feature=feat, feature_dim=d, streaming=True)
+    for i in range(0, n, batch):
+        fid.update(jnp.asarray(real[i : i + batch]), real=True)
+        fid.update(jnp.asarray(fake[i : i + batch]), real=False)
+
+    got = float(fid.compute())
+    exp = _np_fid_f64(real, fake)
+    np.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-3)
+
+
+def test_streaming_equals_buffered_long_stream():
+    """Compensated streaming moments agree with the two-pass buffered path
+    over a long stream (the regime where naive f32 sums drift)."""
+    rng = np.random.RandomState(1)
+    d, n, batch = 8, 50_000, 200
+    real = (5.0 + rng.randn(n, d)).astype(np.float32)
+    fake = (5.2 + rng.randn(n, d)).astype(np.float32)
+
+    feat = lambda x: x  # noqa: E731
+    fid_s = FID(feature=feat, feature_dim=d, streaming=True)
+    fid_b = FID(feature=feat, feature_dim=d)
+    for i in range(0, n, batch):
+        for f, is_real in ((real, True), (fake, False)):
+            fid_s.update(jnp.asarray(f[i : i + batch]), real=is_real)
+            fid_b.update(jnp.asarray(f[i : i + batch]), real=is_real)
+    np.testing.assert_allclose(
+        float(fid_s.compute()), float(fid_b.compute()), rtol=1e-3, atol=1e-3
+    )
+    np.testing.assert_allclose(float(fid_s.compute()), _np_fid_f64(real, fake), rtol=1e-3, atol=1e-3)
+
+
+def test_kahan_add_rescues_f32_sum():
+    """A canonical Kahan check: summing many small values into a large total
+    in f32 loses everything naively, survives with compensation."""
+    total = jnp.asarray(1e8, jnp.float32)
+    comp = jnp.asarray(0.0, jnp.float32)
+    naive = total
+    small = jnp.asarray(1.0, jnp.float32)  # below f32 resolution at 1e8
+    for _ in range(1000):
+        total, comp = kahan_add(total, comp, small)
+        naive = naive + small
+    corrected = float(total - comp)
+    assert abs(corrected - (1e8 + 1000)) < 64.0  # few ulps at 1e8
+    assert abs(float(naive) - 1e8) < 1.0  # naive sum dropped every addend
+
+
+@pytest.mark.parametrize("cond_exponent", [4, 8])
+def test_trace_sqrtm_product_ill_conditioned(cond_exponent):
+    rng = np.random.RandomState(2)
+    d = 24
+    for _ in range(2):
+        q1, _ = np.linalg.qr(rng.randn(d, d))
+        q2, _ = np.linalg.qr(rng.randn(d, d))
+        e1 = np.logspace(-cond_exponent / 2, cond_exponent / 2, d)
+        e2 = np.logspace(-cond_exponent / 2, cond_exponent / 2, d)[::-1]
+        s1 = (q1 * e1) @ q1.T
+        s2 = (q2 * e2) @ q2.T
+        exp = np.trace(scipy_linalg.sqrtm(s1 @ s2).real)
+        got = float(trace_sqrtm_product(jnp.asarray(s1, jnp.float32), jnp.asarray(s2, jnp.float32)))
+        np.testing.assert_allclose(got, exp, rtol=2e-3, atol=1e-3)
+
+
+def test_no_float64_truncation_warnings():
+    """Constructing + updating + computing a streaming FID emits no
+    float64-truncation warning spam (explicit canonical-dtype choice)."""
+    rng = np.random.RandomState(3)
+    feat = lambda x: x  # noqa: E731
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        fid = FID(feature=feat, feature_dim=4, streaming=True)
+        for _ in range(3):
+            fid.update(jnp.asarray(rng.rand(16, 4).astype(np.float32)), real=True)
+            fid.update(jnp.asarray(rng.rand(16, 4).astype(np.float32)), real=False)
+        fid.compute()
+    spam = [w for w in caught if "float64" in str(w.message)]
+    assert not spam, f"float64 truncation warnings emitted: {spam[:3]}"

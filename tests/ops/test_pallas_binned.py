@@ -48,8 +48,16 @@ def test_kernel_threshold_boundary_semantics():
     assert np.allclose(np.asarray(fn), [[0.0, 1.0, 1.0]])
 
 
-def test_dispatch_defaults_to_xla_off_tpu():
-    # on the CPU test platform the auto path must pick XLA (no interpret cost)
+def test_dispatch_defaults_to_xla_off_tpu(monkeypatch):
+    # on the CPU test platform the auto path must pick XLA — assert the
+    # pallas kernel is NOT invoked (outputs alone can't tell: interpret-mode
+    # pallas produces identical values)
+    import metrics_tpu.ops.pallas_binned as mod
+
+    def _boom(*a, **k):
+        raise AssertionError("pallas path must not run for use_pallas=None on CPU")
+
+    monkeypatch.setattr(mod, "_binned_stats_pallas", _boom)
     rng = np.random.RandomState(0)
     preds = jnp.asarray(rng.rand(16, 4).astype(np.float32))
     target = jnp.asarray((rng.rand(16, 4) > 0.5).astype(np.float32))

@@ -47,13 +47,21 @@ want = accuracy_score(labels.reshape(-1), probs.argmax(-1).reshape(-1))
 _assert_close(acc.compute(), want, 1e-6, "accuracy")
 
 # -- cat-state metric with UNEVEN per-rank rows: AUROC ---------------------
+def _rows_for(rank: int, batch_idx: int) -> int:
+    """Single source of truth for the ragged schedule: rank 0 contributes
+    short batches. Drives BOTH the updates and the expected-value mask so
+    they cannot drift (the batch→rank assignment is i % WORLD == rank)."""
+    return BATCH if rank else BATCH - 7
+
+
 auroc = AUROC()
 for i in range(RANK, NUM_BATCHES, WORLD):
-    n = BATCH if RANK else BATCH - 7  # rank 0 contributes short batches
+    n = _rows_for(RANK, i)
     auroc.update(jnp.asarray(bin_probs[i, :n]), jnp.asarray(bin_labels[i, :n]))
-mask = np.ones((NUM_BATCHES, BATCH), bool)
-for i in range(0, NUM_BATCHES, WORLD):
-    mask[i, BATCH - 7 :] = False
+mask = np.zeros((NUM_BATCHES, BATCH), bool)
+for r in range(WORLD):
+    for i in range(r, NUM_BATCHES, WORLD):
+        mask[i, : _rows_for(r, i)] = True
 want = roc_auc_score(bin_labels[mask], bin_probs[mask])
 _assert_close(auroc.compute(), want, 1e-6, "auroc-uneven")
 

@@ -7,6 +7,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from metrics_tpu import BERTScore
 from metrics_tpu.functional import bert_score
 
