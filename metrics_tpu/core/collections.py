@@ -167,7 +167,8 @@ class MetricCollection(dict):
             for k, m in super().items()
         }
 
-    def pure_sync(self, state: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
+    def pure_sync(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Any]:
+        # axis_name=None lets each member fall back to its own process_group
         return {k: m.pure_sync(state[k], axis_name) for k, m in super().items()}
 
     def pure_compute(self, state: Dict[str, Any]) -> Dict[str, Any]:
@@ -180,7 +181,12 @@ class MetricCollection(dict):
         self, state: Dict[str, Any], *args: Any, axis_name: Optional[str] = None, **kwargs: Any
     ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """One fused jittable step for the WHOLE collection: all member
-        updates, one round of collectives, all computes — a single XLA graph."""
+        updates, one round of collectives, all computes — a single XLA graph.
+        ``axis_name`` defaults to the members' shared ``process_group``."""
+        if axis_name is None:
+            groups = {m.process_group for m in super().values() if m.process_group is not None}
+            if len(groups) == 1:
+                axis_name = next(iter(groups))
         batch = self.pure_update(self.init_state(), *args, **kwargs)
         value_state = self.pure_sync(batch, axis_name) if axis_name else batch
         values = self.pure_compute(value_state)

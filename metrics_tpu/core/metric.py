@@ -74,8 +74,14 @@ class Metric:
             ``forward`` (reference ``metric.py:73``).
         dist_sync_on_step: synchronize state across devices/processes when
             computing the per-step value (reference ``metric.py:75``).
-        process_group: unused placeholder kept for API parity; JAX collectives
-            run over all processes (or a named mesh axis via ``pure_sync``).
+        process_group: TPU-native reinterpretation of the reference's
+            torch.distributed sub-group (reference ``metric.py:77``): a mesh
+            axis name (or tuple of names) that ``pure_sync`` syncs over when
+            no explicit ``axis_name`` is passed. Collectives then run only
+            across that axis — devices differing on the remaining mesh axes
+            keep independent values (e.g. sync over ``"dp"`` of a
+            ``("dp", "mp")`` mesh = one group per model shard). The host
+            (out-of-jit) sync path has no sub-group support and raises.
         dist_sync_fn: custom callable ``(state_dict, reductions) -> state_dict``
             replacing the built-in host sync — the seam integrations use
             (reference ``metric.py:78``).
@@ -299,8 +305,16 @@ class Metric:
         )
         if not should_sync or not is_distributed:
             return
-        self._cache = {k: _copy_state_value(v) for k, v in self._state.items()}
         fn = dist_sync_fn or self.dist_sync_fn
+        if self.process_group is not None and fn is None:
+            # loud, not silent: the host all-process path cannot honor a
+            # sub-group; mesh-axis sub-groups live in pure_sync (in-jit)
+            raise MetricsTPUUserError(
+                "`process_group` sub-group sync is only supported in-jit via "
+                "`pure_sync` over mesh axes; the host sync path always spans "
+                "all processes. Drop `process_group` or inject `dist_sync_fn`."
+            )
+        self._cache = {k: _copy_state_value(v) for k, v in self._state.items()}
         if fn is not None:
             synced = fn(self._cache, self._reductions)
         else:
@@ -382,8 +396,21 @@ class Metric:
         finally:
             self._state, self._computed = saved, saved_computed
 
-    def pure_sync(self, state: Dict[str, Any], axis_name: str) -> Dict[str, Any]:
-        """In-jit cross-device sync over a named mesh axis (psum/all_gather)."""
+    def pure_sync(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Any]:
+        """In-jit cross-device sync over named mesh axes (psum/all_gather).
+
+        ``axis_name`` may be one axis name or a tuple of names; defaults to
+        the constructor's ``process_group`` (the mesh-native sub-group:
+        syncing over a subset of a multi-axis mesh leaves one independent
+        value per slice of the remaining axes).
+        """
+        if axis_name is None:
+            axis_name = self.process_group
+        if axis_name is None:
+            raise MetricsTPUUserError(
+                "pure_sync needs a mesh axis: pass `axis_name=` or construct "
+                "the metric with `process_group=<axis or tuple of axes>`."
+            )
         return sync_in_jit(state, self._reductions, axis_name)
 
     def pure_forward(
@@ -392,8 +419,11 @@ class Metric:
         """One fused step: ``(new_state, batch_value)``; sync if ``axis_name``.
 
         This is the jittable hot path: update + (optional) collective sync +
-        compute trace into a single XLA program.
+        compute trace into a single XLA program. ``axis_name`` defaults to
+        the constructor's ``process_group`` (mesh-axis sub-group).
         """
+        if axis_name is None:
+            axis_name = self.process_group
         batch_state = self.pure_update(self.init_state(), *args, **kwargs)
         value_state = self.pure_sync(batch_state, axis_name) if axis_name else batch_state
         value = self.pure_compute(value_state)

@@ -22,7 +22,7 @@ from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.models.inception import InceptionFeatureExtractor
-from metrics_tpu.ops.linalg import kahan_add, trace_sqrtm_product
+from metrics_tpu.ops.linalg import kahan_add, kahan_merge, trace_sqrtm_product
 from metrics_tpu.utils.data import dim_zero_cat
 
 def _high_dtype():
@@ -146,6 +146,24 @@ class FID(Metric):
             self.real_features.append(features)
         else:
             self.fake_features.append(features)
+
+    def merge_states(self, state_a, state_b):
+        """Kahan-aware merge for the streaming moments: the default plain
+        ``a + b`` sum-merge (used by forward accumulation / checkpoint
+        resume / map-reduce) would drop the compensation rescue."""
+        if not self.streaming:
+            return super().merge_states(state_a, state_b)
+        out = dict(state_a)
+        for side in ("real", "fake"):
+            for base in ("sum", "outer"):
+                t, c = kahan_merge(
+                    state_a[f"{side}_{base}"], state_a[f"{side}_{base}_comp"],
+                    state_b[f"{side}_{base}"], state_b[f"{side}_{base}_comp"],
+                )
+                out[f"{side}_{base}"] = t
+                out[f"{side}_{base}_comp"] = c
+            out[f"{side}_n"] = state_a[f"{side}_n"] + state_b[f"{side}_n"]
+        return out
 
     def compute(self) -> Array:
         """FID over all accumulated features (reference ``fid.py:265-284``);

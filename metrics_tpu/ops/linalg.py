@@ -11,6 +11,23 @@ import jax.numpy as jnp
 from jax import Array, lax
 
 
+def kahan_merge(
+    a_total: Array, a_comp: Array, b_total: Array, b_comp: Array
+) -> Tuple[Array, Array]:
+    """Merge two Kahan accumulator pairs into one, preserving the rescue.
+
+    Two-sum captures the roundoff ``e`` of ``a_total + b_total`` exactly, so
+    the merged pair satisfies ``total - comp == (a_total - a_comp) +
+    (b_total - b_comp)`` to full compensated precision. Used by state merges
+    (forward accumulation, checkpoint resume, map-reduce eval).
+    """
+    t = a_total + b_total
+    bv = t - a_total
+    av = t - bv
+    e = (a_total - av) + (b_total - bv)  # exact: a+b == t + e
+    return t, a_comp + b_comp - e
+
+
 def kahan_add(total: Array, comp: Array, value: Array) -> Tuple[Array, Array]:
     """One Kahan-compensated accumulation step: returns ``(total', comp')``.
 

@@ -83,6 +83,21 @@ class TestDistSyncOnStepConfusionMatrix(MetricTester):
         )
 
 
+def test_gather_states_handles_catbuffer():
+    """_gather_states must concatenate fixed-capacity CatBuffer states in
+    rank order into one buffer, not return a python list of buffers."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.core.cat_buffer import CatBuffer
+    from tests.helpers.testers import _gather_states
+
+    a = CatBuffer(8).append(jnp.asarray([1.0, 2.0]))
+    b = CatBuffer(8).append(jnp.asarray([3.0, 4.0, 5.0]))
+    out = _gather_states([{"x": a}, {"x": b}], {"x": None})
+    assert isinstance(out["x"], CatBuffer)
+    np.testing.assert_array_equal(np.asarray(out["x"].values()), [1.0, 2.0, 3.0, 4.0, 5.0])
+
+
 def test_forward_accumulation_stays_local():
     """dist_sync_on_step syncs only the per-step value: after the loop, each
     rank's accumulated state covers just its own batches."""

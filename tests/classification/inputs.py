@@ -74,3 +74,43 @@ _input_multidim_multiclass = Input(
     preds=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE, EXTRA_DIM),
     target=_randint(NUM_CLASSES, NUM_BATCHES, BATCH_SIZE, EXTRA_DIM),
 )
+
+_input_multilabel_logits = Input(
+    preds=(2 * np.random.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32),
+    target=_randint(2, NUM_BATCHES, BATCH_SIZE, NUM_CLASSES),
+)
+
+# edge case: every prediction wrong (scores like precision are 0/undefined)
+__no_match_preds = _randint(2, NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)
+_input_multilabel_no_match = Input(preds=__no_match_preds, target=1 - __no_match_preds)
+
+
+def generate_plausible_inputs_multilabel(num_classes=NUM_CLASSES, num_batches=NUM_BATCHES, batch_size=BATCH_SIZE):
+    """Probs correlated with targets (reference `inputs.py:97-110`) — exercises
+    the non-degenerate regime where curve metrics are informative."""
+    correct = np.random.randint(0, num_classes, (num_batches, batch_size))
+    preds = np.random.rand(num_batches, batch_size, num_classes)
+    targets = np.zeros_like(preds, dtype=np.int64)
+    np.put_along_axis(targets, correct[..., None], 1, axis=2)
+    preds = preds + np.random.rand(num_batches, batch_size, num_classes) * targets / 3
+    preds = preds / preds.sum(axis=2, keepdims=True)
+    return Input(preds=preds.astype(np.float32), target=targets)
+
+
+def generate_plausible_inputs_binary(num_batches=NUM_BATCHES, batch_size=BATCH_SIZE):
+    targets = np.random.randint(0, 2, (num_batches, batch_size))
+    preds = np.random.rand(num_batches, batch_size) + np.random.rand(num_batches, batch_size) * targets / 3
+    return Input(preds=(preds / (preds.max() + 0.01)).astype(np.float32), target=targets)
+
+
+_input_multilabel_prob_plausible = generate_plausible_inputs_multilabel()
+_input_binary_prob_plausible = generate_plausible_inputs_binary()
+
+# multiclass probs where one class never appears in the targets (reference's
+# "randomly remove one class" case — macro averages must handle 0 support)
+__missing_preds = _rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)
+__missing_preds = __missing_preds / __missing_preds.sum(axis=2, keepdims=True)
+__missing_target = _randint(NUM_CLASSES - 1, NUM_BATCHES, BATCH_SIZE)  # class C-1 absent
+_input_multiclass_with_missing_class = Input(
+    preds=__missing_preds.astype(np.float32), target=__missing_target
+)

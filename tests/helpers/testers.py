@@ -61,11 +61,18 @@ def _gather_states(states: Sequence[Dict[str, Any]], reductions: Dict[str, Any])
     """Rank-ordered gather+reduce of per-rank state dicts — the tester's
     stand-in for the reference's ``gather_all_tensors`` + reduction
     (``metric.py:217-242``). Used as an injected ``dist_sync_fn``."""
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
     out: Dict[str, Any] = {}
     for name, red in reductions.items():
         vals = [s[name] for s in states]
         if isinstance(vals[0], list):  # cat-list state: concat in rank order
             out[name] = [x for v in vals for x in v]
+        elif isinstance(vals[0], CatBuffer):  # fixed-capacity cat state
+            gathered = CatBuffer(sum(v.capacity for v in vals))
+            for v in vals:
+                gathered = gathered.merge(v)
+            out[name] = gathered
         elif red == "sum":
             out[name] = sum(vals[1:], vals[0])
         elif red == "mean":

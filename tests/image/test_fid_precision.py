@@ -73,6 +73,37 @@ def test_streaming_equals_buffered_long_stream():
     np.testing.assert_allclose(float(fid_s.compute()), _np_fid_f64(real, fake), rtol=1e-3, atol=1e-3)
 
 
+def test_merge_driven_accumulation_keeps_rescue():
+    """forward()'s accumulation path is merge_states(acc, batch); the
+    Kahan-aware FID merge must preserve compensated precision over a long
+    merge chain (naive `a + b` sum-merge drifts like uncompensated f32)."""
+    rng = np.random.RandomState(4)
+    d, n, batch = 8, 40_000, 100
+    real = (30.0 + rng.randn(n, d)).astype(np.float32)
+    fake = (30.3 + rng.randn(n, d)).astype(np.float32)
+
+    feat = lambda x: x  # noqa: E731
+    fid = FID(feature=feat, feature_dim=d, streaming=True)
+    scratch = FID(feature=feat, feature_dim=d, streaming=True)
+    state = fid.init_state()
+    for i in range(0, n, batch):
+        batch_state = scratch.pure_update(scratch.init_state(), jnp.asarray(real[i : i + batch]), True)
+        batch_state = scratch.pure_update(batch_state, jnp.asarray(fake[i : i + batch]), False)
+        state = fid.merge_states(state, batch_state)
+    got = float(fid.pure_compute(state))
+    np.testing.assert_allclose(got, _np_fid_f64(real, fake), rtol=1e-3, atol=1e-3)
+
+
+def test_kahan_merge_preserves_compensation():
+    from metrics_tpu.ops.linalg import kahan_merge
+
+    a_t, a_c = jnp.asarray(1e8, jnp.float32), jnp.asarray(-512.0, jnp.float32)
+    b_t, b_c = jnp.asarray(3.0, jnp.float32), jnp.asarray(0.25, jnp.float32)
+    t, c = kahan_merge(a_t, a_c, b_t, b_c)
+    exp = (float(a_t) - float(a_c)) + (float(b_t) - float(b_c))
+    assert abs((float(t) - float(c)) - exp) < 16.0  # few ulps at 1e8
+
+
 def test_kahan_add_rescues_f32_sum():
     """A canonical Kahan check: summing many small values into a large total
     in f32 loses everything naively, survives with compensation."""
