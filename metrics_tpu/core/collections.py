@@ -9,6 +9,7 @@ from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
 
 from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 
 class MetricCollection(dict):
@@ -168,8 +169,23 @@ class MetricCollection(dict):
         }
 
     def pure_sync(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Any]:
-        # axis_name=None lets each member fall back to its own process_group
-        return {k: m.pure_sync(state[k], axis_name) for k, m in super().items()}
+        """Collective-sync member states over ``axis_name``.
+
+        ``axis_name=None``: each member syncs over its own declared
+        ``process_group``; members without one keep their local state (what
+        their standalone ``pure_forward`` would do). Raises if no member
+        declares a group — there would be nothing to sync."""
+        if axis_name is not None:
+            return {k: m.pure_sync(state[k], axis_name) for k, m in super().items()}
+        if all(m.process_group is None for m in super().values()):
+            raise MetricsTPUUserError(
+                "pure_sync needs a mesh axis: pass `axis_name=` or construct "
+                "at least one member with `process_group=<axis or tuple>`."
+            )
+        return {
+            k: m.pure_sync(state[k]) if m.process_group is not None else state[k]
+            for k, m in super().items()
+        }
 
     def pure_compute(self, state: Dict[str, Any]) -> Dict[str, Any]:
         return {self._set_name(k): m.pure_compute(state[k]) for k, m in super().items()}
@@ -182,13 +198,18 @@ class MetricCollection(dict):
     ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """One fused jittable step for the WHOLE collection: all member
         updates, one round of collectives, all computes — a single XLA graph.
-        ``axis_name`` defaults to the members' shared ``process_group``."""
-        if axis_name is None:
-            groups = {m.process_group for m in super().values() if m.process_group is not None}
-            if len(groups) == 1:
-                axis_name = next(iter(groups))
+
+        With ``axis_name=None`` each member syncs over its own declared
+        ``process_group`` (members without one stay local) — exactly what the
+        member's standalone ``pure_forward`` would do, so mixed-group
+        collections neither skip a declared sync nor force one on a
+        group-less member."""
         batch = self.pure_update(self.init_state(), *args, **kwargs)
-        value_state = self.pure_sync(batch, axis_name) if axis_name else batch
+        any_group = any(m.process_group is not None for m in super().values())
+        if axis_name is not None or any_group:
+            value_state = self.pure_sync(batch, axis_name)
+        else:
+            value_state = batch
         values = self.pure_compute(value_state)
         new_state = self.merge_states(state, batch)
         return new_state, values

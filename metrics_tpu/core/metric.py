@@ -795,6 +795,22 @@ def _wrap_compute(compute: Callable) -> Callable:
             isinstance(leaf, jax.core.Tracer) for leaf in jax.tree_util.tree_leaves(self._state)
         )
         should = self._to_sync and self._is_synced is False and not is_tracing
+        if (
+            should
+            and self.process_group is not None
+            and self.dist_sync_fn is None
+            and self.distributed_available_fn()
+        ):
+            # a mesh-axis sub-group has no host-path equivalent; the designed
+            # flow is in-jit pure_sync then host compute on the synced state —
+            # raising here (as explicit sync() does) would break that flow
+            rank_zero_warn(
+                "compute() skipped automatic host sync: `process_group` sub-group "
+                "sync only exists in-jit (`pure_sync` over mesh axes). Sync state "
+                "in-jit before compute, or inject `dist_sync_fn`.",
+                UserWarning,
+            )
+            should = False
         with self.sync_context(
             dist_sync_fn=self.dist_sync_fn,
             should_sync=should,

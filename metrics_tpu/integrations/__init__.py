@@ -1,0 +1,15 @@
+"""Framework integrations.
+
+The reference integrates with PyTorch Lightning implicitly — ``Metric`` is an
+``nn.Module`` so Lightning's module system picks metrics up, logs metric
+objects lazily, and resets them at epoch end
+(reference ``integrations/test_lightning.py``, ``docs/source/pages/lightning.rst``).
+
+The TPU-native analogue is explicit and functional: metric state is a pytree
+carried inside the flax ``TrainState``, updated inside the jitted train step
+(one fused XLA program with the model forward/backward), with Lightning-style
+deferred logging + epoch-end auto-reset provided by :class:`MetricLogger`.
+"""
+from metrics_tpu.integrations.flax import MetricLogger, MetricTrainState
+
+__all__ = ["MetricLogger", "MetricTrainState"]

@@ -1,0 +1,198 @@
+"""Flax/optax training-loop integration — the Lightning-integration analogue.
+
+Reference behavior being matched (``integrations/test_lightning.py``,
+``docs/source/pages/lightning.rst``):
+
+- metrics live on the training module and are updated per step inside the
+  training loop (reference ``test_lightning.py:58-63``);
+- logging a *metric object* (``self.log(name, metric)``) defers ``compute``
+  to epoch end and auto-resets the metric exactly once per epoch
+  (reference ``test_lightning.py:86-202`` asserts reset-at-epoch-end and
+  no-reset-mid-epoch);
+- metric state checkpoints with the model (``nn.Module.state_dict``).
+
+TPU-native redesign: instead of module-system hooks, metric state is an
+explicit pytree field on the flax ``TrainState``. The train step stays a pure
+function ``state -> state`` — model forward, loss, grads, optimizer update and
+metric update all trace into ONE XLA program, and the state (including metric
+accumulators) checkpoints atomically with params/opt-state via orbax.
+"""
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import flax.struct
+from flax.training import train_state
+
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+class _StaticRef:
+    """Identity-keyed static holder for the metric collection.
+
+    ``Metric.__eq__`` builds a ``CompositionalMetric`` (reference operator
+    parity) and ``Metric.__hash__`` covers only class + state bytes, so metric
+    objects must NOT serve as jit-cache keys directly: two differently
+    configured metrics (threshold, average, top_k, ...) with identical state
+    shapes would collide in the cache and silently reuse the wrong trace.
+    Identity equality makes distinct collections distinct cache entries.
+    """
+
+    __slots__ = ("value",)
+
+    def __init__(self, value: Any) -> None:
+        self.value = value
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, _StaticRef) and self.value is other.value
+
+    def __hash__(self) -> int:
+        return id(self.value)
+
+
+class MetricTrainState(train_state.TrainState):
+    """A flax ``TrainState`` that carries metric state through the jitted step.
+
+    The :class:`MetricCollection` itself is static (identity-keyed, not
+    traced); its accumulator pytree ``metric_states`` is a regular dataclass
+    field, so it is donated/updated/checkpointed exactly like ``params`` and
+    ``opt_state``.
+
+    Usage::
+
+        metrics = MetricCollection({"acc": Accuracy(num_classes=10)})
+        state = MetricTrainState.create(
+            apply_fn=model.apply, params=params, tx=optax.adam(1e-3),
+            metrics=metrics)
+
+        @jax.jit
+        def train_step(state, x, y):
+            ...grads, new_params...
+            state = state.apply_gradients(grads=grads)
+            return state.update_metrics(jax.nn.softmax(logits), y)
+
+        # epoch end (host side):
+        values = state.compute_metrics()
+        state = state.reset_metrics()
+    """
+
+    metrics_ref: _StaticRef = flax.struct.field(pytree_node=False)
+    metric_states: Dict[str, Dict[str, Any]] = flax.struct.field(default_factory=dict)
+
+    @property
+    def metrics(self) -> MetricCollection:
+        return self.metrics_ref.value
+
+    @classmethod
+    def create(cls, *, apply_fn: Callable, params: Any, tx: Any, metrics: Union[MetricCollection, Metric], **kwargs: Any) -> "MetricTrainState":
+        if isinstance(metrics, Metric):
+            metrics = MetricCollection({type(metrics).__name__.lower(): metrics})
+        if not isinstance(metrics, MetricCollection):
+            raise MetricsTPUUserError(
+                f"`metrics` must be a Metric or MetricCollection, got {type(metrics)}"
+            )
+        return super().create(
+            apply_fn=apply_fn,
+            params=params,
+            tx=tx,
+            metrics_ref=_StaticRef(metrics),
+            metric_states=metrics.init_state(),
+            **kwargs,
+        )
+
+    # -- jit-traceable (pure pytree -> pytree) ---------------------------
+    def update_metrics(self, *args: Any, **kwargs: Any) -> "MetricTrainState":
+        """Accumulate one batch into the carried metric states (traceable)."""
+        return self.replace(metric_states=self.metrics.pure_update(self.metric_states, *args, **kwargs))
+
+    def forward_metrics(
+        self, *args: Any, axis_name: Optional[Any] = None, **kwargs: Any
+    ) -> Tuple["MetricTrainState", Dict[str, Any]]:
+        """Accumulate AND return batch-local values (traceable), optionally
+        synced over a mesh axis — the analogue of logging ``on_step=True``."""
+        new_states, values = self.metrics.pure_forward(
+            self.metric_states, *args, axis_name=axis_name, **kwargs
+        )
+        return self.replace(metric_states=new_states), values
+
+    def sync_metrics(self, axis_name: Any) -> "MetricTrainState":
+        """Collective-reduce metric states over ``axis_name`` (inside
+        shard_map/pmap only)."""
+        return self.replace(metric_states=self.metrics.pure_sync(self.metric_states, axis_name))
+
+    # -- host side -------------------------------------------------------
+    def compute_metrics(self) -> Dict[str, Any]:
+        """Epoch-end values from the accumulated states."""
+        return self.metrics.pure_compute(self.metric_states)
+
+    def reset_metrics(self) -> "MetricTrainState":
+        """Fresh accumulators for the next epoch."""
+        return self.replace(metric_states=self.metrics.init_state())
+
+
+class MetricLogger:
+    """Lightning-style ``self.log`` semantics for eager/stateful metrics.
+
+    Mirrors the behavior the reference's Lightning integration relies on
+    (``integrations/test_lightning.py:123-127``): logging a *metric object*
+    defers ``compute()`` to epoch end and resets the metric exactly once per
+    epoch; logging a plain value records it immediately (mean over the epoch).
+
+    Usage::
+
+        logger = MetricLogger()
+        for batch in epoch:
+            acc(preds, target)                 # stateful update
+            logger.log("train/acc", acc)       # deferred: computed at epoch end
+            logger.log("train/loss", loss)     # immediate: averaged at epoch end
+        values = logger.epoch_end()            # {'train/acc': ..., 'train/loss': ...}
+    """
+
+    def __init__(self) -> None:
+        self._metrics: Dict[str, Union[Metric, MetricCollection]] = {}
+        self._values: Dict[str, List[float]] = {}
+        self.history: List[Dict[str, float]] = []
+
+    def log(self, name: str, value: Any) -> None:
+        if isinstance(value, (Metric, MetricCollection)):
+            if name in self._values:
+                raise MetricsTPUUserError(
+                    f"plain values were already logged under {name!r}"
+                )
+            prev = self._metrics.setdefault(name, value)
+            if prev is not value:
+                raise MetricsTPUUserError(
+                    f"a different metric object was already logged under {name!r}"
+                )
+        else:
+            if name in self._metrics:
+                raise MetricsTPUUserError(
+                    f"a metric object was already logged under {name!r}"
+                )
+            self._values.setdefault(name, []).append(float(value))
+
+    def log_dict(self, values: Dict[str, Any]) -> None:
+        for name, value in values.items():
+            self.log(name, value)
+
+    def epoch_end(self) -> Dict[str, Any]:
+        """Compute deferred metrics, auto-reset them, average plain values."""
+        out: Dict[str, Any] = {}
+        for name, metric in self._metrics.items():
+            value = metric.compute()
+            metric.reset()
+            if isinstance(value, dict):
+                for k, v in value.items():
+                    out[f"{name}/{k}"] = v
+            else:
+                out[name] = value
+        for name, vals in self._values.items():
+            if name in out:  # e.g. a collection logged as 'train' expanded to this key
+                raise MetricsTPUUserError(
+                    f"plain values logged under {name!r} collide with a computed metric entry"
+                )
+            out[name] = sum(vals) / len(vals)
+        self._metrics.clear()
+        self._values.clear()
+        self.history.append(out)
+        return out

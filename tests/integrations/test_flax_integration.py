@@ -1,0 +1,290 @@
+"""Flax-integration tests — the analogue of the reference's Lightning suite
+(``integrations/test_lightning.py``): custom metrics inside a real flax/optax
+training loop, Lightning-style deferred logging with epoch-end auto-reset,
+metric state checkpointed with the train state, and the data-parallel path."""
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import flax.serialization
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metrics_tpu import Accuracy, AveragePrecision, Metric, MetricCollection
+from metrics_tpu.integrations import MetricLogger, MetricTrainState
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+
+class SumMetric(Metric):
+    """Reference ``integrations/test_lightning.py:27-36``."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x + x
+
+    def compute(self):
+        return self.x
+
+
+class DiffMetric(Metric):
+    """Reference ``integrations/test_lightning.py:39-48``."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x - x
+
+    def compute(self):
+        return self.x
+
+
+class BoringModel(nn.Module):
+    """The reference suite's minimal trainable module (`boring_model.py`)."""
+
+    features: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(self.features)(x)
+
+
+def _make_state(metrics, features_in=32, features_out=1, seed=0, **kwargs):
+    model = BoringModel(features=features_out)
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, features_in)))
+    return MetricTrainState.create(
+        apply_fn=model.apply, params=params, tx=optax.sgd(0.1), metrics=metrics, **kwargs
+    )
+
+
+def test_metric_in_train_state():
+    """Analogue of reference ``test_metric_lightning``: a SumMetric updated
+    inside the jitted train step equals the python-side accumulation, and
+    reset_metrics isolates epochs."""
+    state = _make_state(MetricCollection({"sum": SumMetric(), "diff": DiffMetric()}))
+
+    @jax.jit
+    def train_step(state, x, y):
+        def loss_fn(p):
+            out = state.apply_fn(p, x)
+            return jnp.mean((out - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        state = state.apply_gradients(grads=grads)
+        return state.update_metrics(x.sum()), loss
+
+    rng = np.random.RandomState(0)
+    for _epoch in range(2):
+        expected = 0.0
+        losses = []
+        for _ in range(3):
+            x = jnp.asarray(rng.randn(4, 32).astype(np.float32))
+            y = jnp.zeros((4, 1), jnp.float32)
+            state, loss = train_step(state, x, y)
+            expected += float(x.sum())
+            losses.append(float(loss))
+        values = state.compute_metrics()
+        np.testing.assert_allclose(float(values["sum"]), expected, rtol=1e-5)
+        np.testing.assert_allclose(float(values["diff"]), -expected, rtol=1e-5)
+        state = state.reset_metrics()
+    # the model actually trained (loss decreased over the run)
+    assert losses[-1] < losses[0] * 1.5  # noqa: loose — sgd on random targets
+
+
+def test_single_metric_promoted_to_collection():
+    state = _make_state(SumMetric())
+    state = state.update_metrics(jnp.asarray(3.0))
+    assert float(state.compute_metrics()["summetric"]) == 3.0
+    with pytest.raises(MetricsTPUUserError):
+        _make_state(metrics="not-a-metric")
+
+
+def test_forward_metrics_batch_values():
+    """``forward_metrics`` returns the batch-local value while accumulating —
+    the analogue of Lightning's ``on_step=True`` logging."""
+    state = _make_state(MetricCollection({"sum": SumMetric()}))
+    state, step1 = state.forward_metrics(jnp.asarray(2.0))
+    state, step2 = state.forward_metrics(jnp.asarray(5.0))
+    assert float(step1["sum"]) == 2.0
+    assert float(step2["sum"]) == 5.0
+    assert float(state.compute_metrics()["sum"]) == 7.0
+
+
+def test_metrics_reset_at_epoch_end_only():
+    """Analogue of reference ``test_metrics_reset`` (test_lightning.py:86-202):
+    metrics logged through the logger reset exactly once per epoch end and
+    never mid-epoch, across train/val/test stages."""
+    resets = {}
+    metrics = {}
+    for stage in ("train", "val", "test"):
+        acc = Accuracy()
+        ap = AveragePrecision(pos_label=1)
+        for name, m in ((f"acc_{stage}", acc), (f"ap_{stage}", ap)):
+            resets[name] = 0
+            orig, nm = m.reset, name
+
+            def counted(orig=orig, nm=nm):
+                resets[nm] += 1
+                return orig()
+
+            m.reset = counted
+            metrics[name] = m
+
+    logger = MetricLogger()
+    rng = np.random.RandomState(3)
+
+    def run_stage(stage):
+        acc, ap = metrics[f"acc_{stage}"], metrics[f"ap_{stage}"]
+        for _ in range(2):
+            probs = jnp.asarray(rng.rand(8).astype(np.float32))
+            labels = jnp.asarray(rng.randint(0, 2, (8,)))
+            acc(probs, labels)
+            ap(probs, labels)
+            logger.log(f"{stage}/accuracy", acc)
+            logger.log(f"{stage}/ap", ap)
+            # mid-epoch: nothing reset
+            assert resets[f"acc_{stage}"] == 0 and resets[f"ap_{stage}"] == 0
+        out = logger.epoch_end()
+        assert resets[f"acc_{stage}"] == 1 and resets[f"ap_{stage}"] == 1
+        assert 0.0 <= float(out[f"{stage}/accuracy"]) <= 1.0
+        resets[f"acc_{stage}"] = resets[f"ap_{stage}"] = 0
+
+    for stage in ("train", "val", "test"):
+        run_stage(stage)
+    run_stage("val")  # trainer.validate()
+    run_stage("test")  # trainer.test()
+
+
+def test_logger_plain_values_and_conflicts():
+    logger = MetricLogger()
+    logger.log("loss", 1.0)
+    logger.log("loss", 3.0)
+    m = SumMetric()
+    m.update(jnp.asarray(4.0))
+    logger.log("sum", m)
+    with pytest.raises(MetricsTPUUserError):
+        logger.log("sum", SumMetric())  # different object under same name
+    out = logger.epoch_end()
+    assert out["loss"] == 2.0  # mean over the epoch
+    assert float(out["sum"]) == 4.0
+    assert logger.history == [out]
+    # collections expand into name/key entries
+    mc = MetricCollection({"acc": Accuracy(num_classes=2)})
+    mc.update(jnp.asarray([[0.9, 0.1], [0.2, 0.8]]), jnp.asarray([0, 1]))
+    logger.log("train", mc)
+    out2 = logger.epoch_end()
+    assert float(out2["train/acc"]) == 1.0
+
+
+def test_metric_state_checkpoints_with_train_state():
+    """Metric accumulators serialize/restore atomically with params/opt-state —
+    the analogue of metric states inside ``nn.Module.state_dict``."""
+    state = _make_state(MetricCollection({"acc": Accuracy(num_classes=3)}))
+    preds = jnp.asarray(np.eye(3)[[0, 1, 2, 0]].astype(np.float32))
+    target = jnp.asarray([0, 1, 2, 1])
+    state = state.update_metrics(preds, target)
+
+    blob = flax.serialization.to_bytes(state)
+    fresh = _make_state(MetricCollection({"acc": Accuracy(num_classes=3)}))
+    restored = flax.serialization.from_bytes(fresh, blob)
+    np.testing.assert_allclose(
+        float(restored.compute_metrics()["acc"]), float(state.compute_metrics()["acc"])
+    )
+    # restored state keeps accumulating correctly
+    restored = restored.update_metrics(preds, jnp.asarray([0, 1, 2, 0]))
+    assert float(restored.compute_metrics()["acc"]) == pytest.approx(7 / 8)
+
+
+def test_data_parallel_train_step():
+    """DP analogue of the reference's DDP Lightning run: per-device metric
+    update inside shard_map, collective sync at epoch end, one XLA program."""
+    n = 8
+    mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+    mc = MetricCollection({"acc": Accuracy(num_classes=4)})
+    summ = SumMetric()
+    state = _make_state(mc, features_in=4, features_out=4)
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(n * 4, 4).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 4, (n * 4,)))
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(), P("dp"), P("dp")),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def epoch(metric_states, sum_state, xs, ys):
+        def loss_fn(p):
+            logits = state.apply_fn(p, xs)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, ys).mean()
+
+        jax.grad(loss_fn)(state.params)  # the model step traces alongside
+        logits = state.apply_fn(state.params, xs)
+        ms = mc.pure_update(metric_states, jax.nn.softmax(logits), ys)
+        ss = summ.pure_update(sum_state, xs.sum())
+        return mc.pure_sync(ms, "dp"), summ.pure_sync(ss, "dp")
+
+    with mesh:
+        synced, sum_synced = jax.jit(epoch)(
+            state.metric_states,
+            summ.init_state(),
+            jax.device_put(x, NamedSharding(mesh, P("dp"))),
+            jax.device_put(y, NamedSharding(mesh, P("dp"))),
+        )
+    state = state.replace(metric_states=synced)
+    values = state.compute_metrics()
+
+    # global-batch reference
+    logits = state.apply_fn(state.params, x)
+    expected_acc = float((jnp.argmax(logits, -1) == y).mean())
+    np.testing.assert_allclose(float(values["acc"]), expected_acc, rtol=1e-6)
+    np.testing.assert_allclose(float(summ.pure_compute(sum_synced)), float(x.sum()), rtol=1e-5)
+
+
+def test_distinct_metric_configs_do_not_share_jit_cache():
+    """Metric.__hash__/__eq__ can't key the jit cache (operator-overload
+    parity), so the static collection is identity-keyed: two differently
+    configured metrics with identical state shapes must NOT reuse one trace."""
+    s_lo = _make_state(MetricCollection({"acc": Accuracy(threshold=0.5)}))
+    s_hi = _make_state(MetricCollection({"acc": Accuracy(threshold=0.9)}))
+
+    @jax.jit
+    def step(state, p, t):
+        return state.update_metrics(p, t)
+
+    probs = jnp.asarray([0.6, 0.7, 0.8, 0.2])
+    labels = jnp.asarray([1, 1, 1, 0])
+    lo = float(step(s_lo, probs, labels).compute_metrics()["acc"])
+    hi = float(step(s_hi, probs, labels).compute_metrics()["acc"])
+    assert lo == 1.0   # all three positives clear 0.5
+    assert hi == 0.25  # only the negative is classified correctly at 0.9
+
+
+def test_logger_name_collisions_between_kinds_raise():
+    logger = MetricLogger()
+    m = SumMetric()
+    m.update(jnp.asarray(1.0))
+    logger.log("a", m)
+    with pytest.raises(MetricsTPUUserError, match="metric object was already logged"):
+        logger.log("a", 0.5)
+    logger.log("b", 0.5)
+    with pytest.raises(MetricsTPUUserError, match="plain values were already logged"):
+        logger.log("b", m)
+    # collection expansion colliding with a plain value is loud, not silent
+    mc = MetricCollection({"acc": Accuracy(num_classes=2)})
+    mc.update(jnp.asarray([[0.9, 0.1]]), jnp.asarray([0]))
+    logger2 = MetricLogger()
+    logger2.log("train", mc)
+    logger2.log("train/acc", 0.0)
+    with pytest.raises(MetricsTPUUserError, match="collide"):
+        logger2.epoch_end()

@@ -152,3 +152,108 @@ def test_host_sync_with_process_group_raises():
              jnp.asarray(rng.randint(0, NUM_CLASSES, 8)))
     with pytest.raises(MetricsTPUUserError, match="sub-group"):
         m.sync(distributed_available=lambda: True)
+
+
+def test_collection_pure_forward_mixed_groups_per_member():
+    """A collection mixing a sub-group member and a group-less member: the
+    grouped member's per-step value syncs over ITS axis, the group-less one
+    stays device-local — matching each member's standalone pure_forward."""
+    from metrics_tpu import MetricCollection
+
+    preds = rng.rand(DP, MP, BATCH, NUM_CLASSES).astype(np.float32)
+    target = rng.randint(0, NUM_CLASSES, (DP, MP, BATCH))
+
+    mc = MetricCollection(
+        {
+            "grouped": Accuracy(num_classes=NUM_CLASSES, process_group="dp"),
+            "local": Accuracy(num_classes=NUM_CLASSES),
+        }
+    )
+    mc.update(jnp.asarray(preds[0, 0]), jnp.asarray(target[0, 0]))
+    mc.reset()
+    mesh = _mesh()
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P("dp", "mp"), P("dp", "mp")),
+        out_specs=P("dp", "mp"),
+        check_vma=False,
+    )
+    def step(p, t):
+        _, values = mc.pure_forward(mc.init_state(), p[0, 0], t[0, 0])
+        return jnp.stack([values["grouped"], values["local"]]).reshape(1, 1, 2)
+
+    out = np.asarray(step(jnp.asarray(preds), jnp.asarray(target)))  # (DP, MP, 2)
+    for col in range(MP):
+        exp_group = accuracy_score(
+            target[:, col].reshape(-1), preds[:, col].reshape(-1, NUM_CLASSES).argmax(-1)
+        )
+        for row in range(DP):
+            np.testing.assert_allclose(out[row, col, 0], exp_group, atol=1e-6)
+            exp_local = accuracy_score(target[row, col], preds[row, col].argmax(-1))
+            np.testing.assert_allclose(out[row, col, 1], exp_local, atol=1e-6)
+    # the local member genuinely varies across dp rows (no forced group sync)
+    assert not np.allclose(out[0, 0, 1], out[1, 0, 1])
+
+
+def test_host_compute_with_process_group_warns_not_raises():
+    """Epoch-end compute() on a sub-group metric must not raise in a real
+    multi-process run: the in-jit pure_sync is the designed sync path, so the
+    automatic host sync is skipped with a warning instead."""
+    m = Accuracy(num_classes=NUM_CLASSES, process_group="dp")
+    m.distributed_available_fn = lambda: True  # simulate multi-process
+    p = rng.rand(8, NUM_CLASSES).astype(np.float32)
+    t = rng.randint(0, NUM_CLASSES, 8)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    with pytest.warns(UserWarning, match="skipped automatic host sync"):
+        val = m.compute()
+    np.testing.assert_allclose(float(val), accuracy_score(t, p.argmax(-1)), atol=1e-6)
+    # explicit sync() keeps the loud failure
+    with pytest.raises(MetricsTPUUserError, match="sub-group"):
+        m.sync(distributed_available=lambda: True)
+
+
+def test_collection_pure_sync_mixed_groups():
+    """Public-API epoch-end sync of a mixed collection: grouped members sync
+    over their own axis, group-less members keep local state; an all-group-less
+    collection raises (nothing to sync)."""
+    from metrics_tpu import MetricCollection
+
+    preds = rng.rand(DP, MP, BATCH, NUM_CLASSES).astype(np.float32)
+    target = rng.randint(0, NUM_CLASSES, (DP, MP, BATCH))
+
+    mc = MetricCollection(
+        {
+            "grouped": Accuracy(num_classes=NUM_CLASSES, process_group="dp"),
+            "local": Accuracy(num_classes=NUM_CLASSES),
+        }
+    )
+    mc.update(jnp.asarray(preds[0, 0]), jnp.asarray(target[0, 0]))
+    mc.reset()
+    mesh = _mesh()
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P("dp", "mp"), P("dp", "mp")),
+        out_specs=P("dp", "mp"),
+        check_vma=False,
+    )
+    def epoch_end(p, t):
+        state = mc.pure_update(mc.init_state(), p[0, 0], t[0, 0])
+        synced = mc.pure_sync(state)  # no axis: per-member process_group
+        values = mc.pure_compute(synced)
+        return jnp.stack([values["grouped"], values["local"]]).reshape(1, 1, 2)
+
+    out = np.asarray(epoch_end(jnp.asarray(preds), jnp.asarray(target)))
+    for col in range(MP):
+        exp_group = accuracy_score(
+            target[:, col].reshape(-1), preds[:, col].reshape(-1, NUM_CLASSES).argmax(-1)
+        )
+        for row in range(DP):
+            np.testing.assert_allclose(out[row, col, 0], exp_group, atol=1e-6)
+            exp_local = accuracy_score(target[row, col], preds[row, col].argmax(-1))
+            np.testing.assert_allclose(out[row, col, 1], exp_local, atol=1e-6)
+
+    all_local = MetricCollection({"a": Accuracy(num_classes=NUM_CLASSES)})
+    with pytest.raises(MetricsTPUUserError, match="mesh axis"):
+        all_local.pure_sync(all_local.init_state())
