@@ -3,9 +3,16 @@
 Default (driver contract): runs BASELINE config 1 and prints ONE JSON line
 ``{"metric", "value", "unit", "vs_baseline"}``.
 
-``python bench.py --all`` additionally runs BASELINE configs 2-5 (one JSON
+``python bench.py --all`` additionally runs BASELINE configs 2-6 (one JSON
 line each; see BASELINE.md for the config table and BENCH.md for recorded
 numbers).
+
+Timing methodology (see BENCH.md): hot paths are timed **on-chip** by
+scanning K steps inside ONE jitted program (``lax.scan``) and dividing — a
+per-call python loop measures the host→device dispatch path instead (2.2 ms
+per call over this environment's remote-TPU tunnel, which would swamp every
+sub-millisecond kernel). Compute paths are warmed once so XLA compile time
+(reported separately as a diagnostic) never pollutes a steady-state number.
 
 The baseline proxy for config 1 is a faithful torch-CPU implementation of the
 same accumulation (the reference publishes no performance numbers —
@@ -21,8 +28,7 @@ import numpy as np
 
 BATCH = 2048
 NUM_CLASSES = 10
-STEPS = 200
-WARM = 20
+SCAN_STEPS = 200
 
 
 def _ensure_backend(probe_timeout: int = 240, attempts: int = 2) -> str:
@@ -75,24 +81,116 @@ def _ensure_backend(probe_timeout: int = 240, attempts: int = 2) -> str:
     return jax.devices()[0].platform
 
 
-def _time_steps(fn, *args, steps=STEPS, warm=WARM):
-    """Median-free simple wall-clock: warm the dispatch path, then average."""
-    import jax
+def _diag(**kv) -> None:
+    print(json.dumps({"diagnostic": kv}), file=sys.stderr)
 
-    out = None
-    for _ in range(warm):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / steps
+
+def _emit(metric, value, unit, vs=None):
+    print(json.dumps({"metric": metric, "value": value, "unit": unit, "vs_baseline": vs}))
+
+
+REPS = 3
+
+
+def _fetch_scalar(tree) -> float:
+    """Force completion: reduce every leaf to one scalar and PULL it to host.
+
+    Over the remote-TPU tunnel `block_until_ready` returns before execution
+    finishes, so wall-clock timing is only honest if the measurement ends
+    with a data-dependent device->host read.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves = [x for x in jax.tree_util.tree_leaves(tree) if hasattr(x, "dtype")]
+    total = sum(jnp.sum(jnp.asarray(leaf, jnp.float32)) for leaf in leaves)
+    return float(total)
+
+
+def _time_scan_step(pure_step, state0, k1: int, k2: int):
+    """On-chip per-step seconds by SLOPE: (t(k2) - t(k1)) / (k2 - k1).
+
+    Each measurement scans K steps in ONE jitted program and ends with a
+    scalar readback; medians over REPS runs cancel the tunnel's 60-150 ms
+    per-call jitter, and the slope cancels its mean (BENCH.md).
+    Returns (per_step_seconds, compile_seconds, final_state_of_k2).
+    """
+    import jax
+    from jax import lax
+
+    compile_s = 0.0
+    medians = {}
+    spreads = {}
+    final = None
+    for k in (k1, k2):
+
+        @jax.jit
+        def run(s0, k=k):
+            return lax.scan(lambda s, _: (pure_step(s), None), s0, None, length=k)[0]
+
+        t0 = time.perf_counter()
+        out = run(state0)
+        _fetch_scalar(out)
+        compile_s += time.perf_counter() - t0
+        ts = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            out = run(state0)
+            _fetch_scalar(out)
+            ts.append(time.perf_counter() - t0)
+        medians[k] = sorted(ts)[len(ts) // 2]
+        spreads[k] = max(ts) - min(ts)
+        if k == k2:
+            final = out
+    per_step = max(medians[k2] - medians[k1], 0.0) / (k2 - k1)
+    # measurement resolution: tunnel jitter over the step-count difference.
+    # a slope below it only bounds the per-step cost from above.
+    resolution = max(spreads.values()) / (k2 - k1)
+    return per_step, compile_s, resolution, final
+
+
+def _time_repeat_compute(compute_fn, state, perturb, k1: int = 2, k2: int = 10):
+    """Per-call seconds of a jittable compute by slope, defeating CSE.
+
+    Runs compute K times inside one scan; `perturb(state, i)` must make each
+    iteration's input unique (tiny additive noise) so XLA cannot hoist the
+    loop-invariant body. Returns (per_call_s, compile_s, value).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    compile_s = 0.0
+    medians = {}
+    spreads = {}
+    for k in (k1, k2):
+
+        @jax.jit
+        def run(s, k=k):
+            def body(acc, i):
+                out = compute_fn(perturb(s, i))
+                leaves = [x for x in jax.tree_util.tree_leaves(out) if hasattr(x, "dtype")]
+                return acc + sum(jnp.sum(jnp.asarray(x, jnp.float32)) for x in leaves), None
+
+            return lax.scan(body, jnp.asarray(0.0), jnp.arange(k))[0]
+
+        t0 = time.perf_counter()
+        _ = float(run(state))
+        compile_s += time.perf_counter() - t0
+        ts = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            _ = float(run(state))
+            ts.append(time.perf_counter() - t0)
+        medians[k] = sorted(ts)[len(ts) // 2]
+        spreads[k] = max(ts) - min(ts)
+    per_call = max(medians[k2] - medians[k1], 0.0) / (k2 - k1)
+    resolution = max(spreads.values()) / (k2 - k1)
+    return max(per_call, resolution), compile_s, compute_fn(state)
 
 
 def bench_ours() -> float:
-    """Config 1: Accuracy + StatScores fused update step."""
-    import jax
+    """Config 1: Accuracy + StatScores fused update step (on-chip)."""
     import jax.numpy as jnp
 
     from metrics_tpu import Accuracy, MetricCollection, StatScores
@@ -104,27 +202,13 @@ def bench_ours() -> float:
     preds = jnp.asarray(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
     target = jnp.asarray(rng.randint(0, NUM_CLASSES, (BATCH,)))
 
-    # donate the state pytree: accumulators update in place in HBM
-    step = jax.jit(mc.pure_update, donate_argnums=(0,))
-
-    state = mc.init_state()
-    state = step(state, preds, target)  # compile
-    jax.block_until_ready(state)
-
-    class _Loop:
-        def __init__(self):
-            self.state = state
-
-        def __call__(self, p, t):
-            self.state = step(self.state, p, t)
-            return self.state
-
-    loop = _Loop()
-    dt = _time_steps(loop, preds, target)
-    # sanity: value must be finite
-    vals = mc.pure_compute(loop.state)
+    per_step, compile_s, resolution, final = _time_scan_step(
+        lambda s: mc.pure_update(s, preds, target), mc.init_state(), k1=500, k2=4000
+    )
+    vals = mc.pure_compute(final)
     assert np.isfinite(float(np.asarray(vals["acc"]))), "bench produced non-finite metric"
-    return dt
+    _diag(config=1, compile_s=round(compile_s, 1), resolution_us=round(resolution * 1e6, 2))
+    return max(per_step, resolution)
 
 
 def bench_torch_baseline() -> float:
@@ -153,23 +237,18 @@ def bench_torch_baseline() -> float:
     st = (z, z.clone(), z.clone(), z.clone(), torch.zeros((), dtype=torch.long), 0)
     st = step(*st)  # warm
     t0 = time.perf_counter()
-    for _ in range(STEPS):
+    for _ in range(SCAN_STEPS):
         st = step(*st)
-    return (time.perf_counter() - t0) / STEPS
-
-
-def _emit(metric, value, unit, vs=None):
-    print(json.dumps({"metric": metric, "value": value, "unit": unit, "vs_baseline": vs}))
+    return (time.perf_counter() - t0) / SCAN_STEPS
 
 
 def bench_config2() -> None:
     """Config 2: AUROC (CatBuffer cat-state) + ConfusionMatrix collection."""
-    import jax
     import jax.numpy as jnp
 
     from metrics_tpu import AUROC, ConfusionMatrix, MetricCollection
 
-    batch, steps_cap = 1024, 64
+    batch, steps_cap = 1024, 2048  # 2k steps of 1k rows: 8 MB buffer
     mc = MetricCollection(
         {
             "auroc": AUROC().with_capacity(batch * steps_cap),
@@ -180,62 +259,60 @@ def bench_config2() -> None:
     preds = jnp.asarray(rng.rand(batch).astype(np.float32))
     target = jnp.asarray(rng.randint(0, 2, (batch,)))
     mc.update(preds, target)  # warm eager mode detection
-    state0 = mc.init_state()
-    step = jax.jit(mc.pure_update, donate_argnums=(0,))
-    state = step(state0, preds, target)
-    jax.block_until_ready(state)
 
-    holder = {"s": state}
-
-    def loop(p, t):
-        holder["s"] = step(holder["s"], p, t)
-        return holder["s"]
-
-    # buffer capacity = batch * steps_cap rows; 1 compile step + `warm`
-    # warmup steps already consumed rows, so the timed loop takes the rest —
-    # derived from capacity so changing WARM cannot overflow the CatBuffer.
-    steps = steps_cap - WARM - 1
-    assert steps > 0, f"WARM={WARM} leaves no timed steps for capacity {steps_cap}"
-    dt = _time_steps(loop, preds, target, steps=steps, warm=WARM)
-    val = mc.pure_compute(holder["s"])
-    n_rows = int(np.asarray(holder["s"]["auroc"]["preds"].count))
+    state0 = mc.pure_update(mc.init_state(), preds, target)  # 1 row block in
+    k1, k2 = 255, steps_cap - 1
+    per_step, compile_s, resolution, final = _time_scan_step(
+        lambda s: mc.pure_update(s, preds, target), state0, k1=k1, k2=k2
+    )
+    n_rows = int(np.asarray(final["auroc"]["preds"].count))
     assert n_rows == batch * steps_cap, f"CatBuffer row count {n_rows} != capacity {batch * steps_cap}"
+    val = mc.pure_compute(final)
     assert np.isfinite(float(np.asarray(val["auroc"])))
-    _emit("auroc_confmat_fused_step", round(dt * 1e6, 2), "us/step")
+    upper_bound = per_step < resolution
+    _diag(config=2, compile_s=round(compile_s, 1), upper_bound=upper_bound,
+          resolution_us=round(resolution * 1e6, 2))
+    _emit("auroc_confmat_fused_step", round(max(per_step, resolution) * 1e6, 2), "us/step")
 
 
 def bench_config3() -> None:
-    """Config 3: FID — Inception-v3 forward + streaming moments on device."""
+    """Config 3: FID — Inception-v3 forward + streaming moments on device,
+    and the compute (Newton–Schulz trace sqrtm on TPU) timed steady-state."""
     import jax
     import jax.numpy as jnp
 
     from metrics_tpu import FID
 
     fid = FID(feature=2048, streaming=True)
-    batch = 32
+    batch = 64
     rng = np.random.RandomState(0)
     imgs = jnp.asarray(rng.rand(batch, 3, 299, 299).astype(np.float32))
 
-    fid.update(imgs, real=True)  # compile both paths
-    fid.update(imgs, real=False)
+    state0 = fid.pure_update(fid.init_state(), imgs, True)
+    per_step, compile_s, resolution, final = _time_scan_step(
+        lambda s: fid.pure_update(s, imgs, True), state0, k1=4, k2=36
+    )
+    per_step = max(per_step, resolution)
+    final = fid.pure_update(final, imgs, False)
 
-    def step(im):
-        fid.update(im, real=True)
-        return fid.real_n
+    def perturb(state, i):
+        out = dict(state)
+        out["real_sum"] = state["real_sum"] + i * 1e-12
+        return out
 
-    dt = _time_steps(step, imgs, steps=8, warm=2)
-    t0 = time.perf_counter()
-    val = fid.compute()
-    jax.block_until_ready(val)
-    dt_compute = time.perf_counter() - t0
-    _emit("fid_inception_forward", round(batch / dt, 1), "imgs/s")
-    _emit("fid_compute_sqrtm", round(dt_compute, 3), "s")
+    per_call, compute_compile_s, val = _time_repeat_compute(fid.pure_compute, final, perturb)
+    assert np.isfinite(float(np.asarray(val)))
+    _diag(config=3, update_compile_s=round(compile_s, 1), compute_compile_s=round(compute_compile_s, 1))
+    _emit("fid_inception_forward", round(batch / per_step, 1), "imgs/s")
+    _emit("fid_compute_sqrtm", round(per_call, 3), "s")
 
 
 def bench_config4() -> None:
-    """Config 4: BERTScore — in-framework BERT forward as the scoring engine."""
-    import jax
-
+    """Config 4: BERTScore — in-framework BERT forward as the scoring engine
+    (steady-state wall time: tokenization + embedding + greedy match; the
+    compute mixes host batching and device programs, so it is timed
+    end-to-end with a median over repeats, value fetched to force
+    completion)."""
     from metrics_tpu import BERTScore
 
     sents_per_batch = 64
@@ -246,40 +323,127 @@ def bench_config4() -> None:
         bs.update(preds, refs)
     t0 = time.perf_counter()
     out = bs.compute()
-    jax.block_until_ready(out["f1"])
-    dt = time.perf_counter() - t0
+    _ = float(np.mean(out["f1"]))
+    first = time.perf_counter() - t0
+    ts = []
+    for _ in range(REPS):
+        bs._computed = None
+        t0 = time.perf_counter()
+        out = bs.compute()
+        _ = float(np.mean(out["f1"]))
+        ts.append(time.perf_counter() - t0)
+    dt = sorted(ts)[len(ts) // 2]
+    _diag(config=4, compile_s=round(first - dt, 1))
     _emit("bertscore_compute", round(4 * sents_per_batch / dt, 1), "sentences/s")
 
 
 def bench_config5() -> None:
-    """Config 5: RetrievalMAP + NDCG over ragged query groups (segment ops)."""
+    """Config 5: RetrievalMAP + NDCG over ragged query groups (segment ops),
+    steady-state, vs the reference's per-query python-loop mechanism in
+    torch-CPU (reference ``retrieval/retrieval_metric.py:93-139``)."""
     import jax.numpy as jnp
 
     from metrics_tpu import RetrievalMAP, RetrievalNormalizedDCG
 
     n, queries = 65536, 1024
     rng = np.random.RandomState(0)
-    idx = jnp.asarray(rng.randint(0, queries, (n,)))
-    preds = jnp.asarray(rng.rand(n).astype(np.float32))
-    target = jnp.asarray(rng.randint(0, 2, (n,)))
+    idx_np = rng.randint(0, queries, (n,))
+    preds_np = rng.rand(n).astype(np.float32)
+    target_np = rng.randint(0, 2, (n,))
+    idx, preds, target = jnp.asarray(idx_np), jnp.asarray(preds_np), jnp.asarray(target_np)
 
-    m_map = RetrievalMAP()
-    m_ndcg = RetrievalNormalizedDCG()
-    m_map.update(preds, target, idx)
-    m_ndcg.update(preds, target, idx)
+    m_map = RetrievalMAP(num_queries=queries)
+    m_ndcg = RetrievalNormalizedDCG(num_queries=queries)
+    s_map = m_map.pure_update(m_map.init_state(), preds, target, idx)
+    s_ndcg = m_ndcg.pure_update(m_ndcg.init_state(), preds, target, idx)
 
-    t0 = time.perf_counter()
-    v1 = m_map.compute()
-    v2 = m_ndcg.compute()
-    dt = time.perf_counter() - t0
+    def both(state_pair):
+        a, b = state_pair
+        return m_map.pure_compute(a), m_ndcg.pure_compute(b)
+
+    def perturb(state_pair, i):
+        a, b = state_pair
+        a2 = dict(a)
+        # cat-states are lists of per-batch arrays in eager mode
+        a2["preds"] = [x + i * 1e-12 for x in a["preds"]]
+        return a2, b
+
+    per_call, compile_s, (v1, v2) = _time_repeat_compute(both, (s_map, s_ndcg), perturb)
     assert np.isfinite(float(np.asarray(v1))) and np.isfinite(float(np.asarray(v2)))
-    _emit("retrieval_map_ndcg_compute", round(dt * 1e3, 2), "ms/65536-docs")
+
+    # reference mechanism: group rows per query id in python, loop groups
+    try:
+        import torch
+
+        tp, tt = torch.from_numpy(preds_np), torch.from_numpy(target_np)
+        groups = {}
+        for i, q in enumerate(idx_np):
+            groups.setdefault(int(q), []).append(i)
+        t0 = time.perf_counter()
+        maps, ndcgs = [], []
+        for rows in groups.values():
+            ridx = torch.tensor(rows)
+            p, t = tp[ridx], tt[ridx]
+            order = torch.argsort(p, descending=True)
+            rel = t[order].float()
+            pos = torch.arange(1, len(rows) + 1, dtype=torch.float32)
+            csum = rel.cumsum(0)
+            maps.append(float((csum / pos * rel).sum() / rel.sum()) if rel.sum() else 0.0)
+            dcg = float((rel / torch.log2(pos + 1)).sum())
+            irel = torch.sort(rel, descending=True).values
+            idcg = float((irel / torch.log2(pos + 1)).sum())
+            ndcgs.append(dcg / idcg if idcg else 0.0)
+        base_s = time.perf_counter() - t0
+        vs = round(base_s / per_call, 1)
+    except Exception:
+        vs = None
+    _diag(config=5, compile_s=round(compile_s, 1))
+    _emit("retrieval_map_ndcg_compute", round(per_call * 1e3, 2), "ms/65536-docs", vs)
+
+
+def bench_config6() -> None:
+    """Config 6: pallas binned PR-curve kernel vs fused-XLA path on hardware
+    (VERDICT round-1: the claimed pallas speedup was never captured in a
+    BENCH artifact)."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.ops.pallas_binned import binned_stat_scores
+
+    n, c, t = 65536, 8, 128
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(n, c).astype(np.float32))
+    target = jnp.asarray((rng.rand(n, c) > 0.5).astype(np.int32))
+    thresholds = jnp.linspace(0.0, 1.0, t)
+
+    results = {}
+    for name, flag in (("xla", False), ("pallas", True)):
+        if flag and jax.default_backend() != "tpu":
+            continue
+
+        def compute(p, flag=flag):
+            return binned_stat_scores(p, target, thresholds, use_pallas=flag)
+
+        def perturb(p, i):
+            return p + i * 1e-9
+
+        try:
+            per_call, compile_s, out = _time_repeat_compute(compute, preds, perturb)
+        except Exception as e:  # pallas may be unsupported on this chip rev
+            _diag(config=6, path=name, error=str(e)[:200])
+            continue
+        results[name] = per_call
+        _diag(config=6, path=name, compile_s=round(compile_s, 1))
+    if "xla" in results:
+        vs = round(results["xla"] / results["pallas"], 2) if "pallas" in results else None
+        key = "pallas" if "pallas" in results else "xla"
+        _emit("binned_pr_stats_65k_rows", round(results[key] * 1e3, 3), "ms", vs)
 
 
 def main() -> None:
     try:
         platform = _ensure_backend()
-        print(json.dumps({"diagnostic": f"benching on platform={platform}"}), file=sys.stderr)
+        _diag(platform=platform)
         ours = bench_ours()
     except Exception as e:  # noqa: BLE001 — contract line must appear no matter what
         print(
@@ -300,12 +464,18 @@ def main() -> None:
     except Exception:
         vs = None
     _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
-    if "--all" in sys.argv:
-        for cfg in (bench_config2, bench_config3, bench_config4, bench_config5):
-            try:
-                cfg()
-            except Exception as e:  # noqa: BLE001 — keep later configs running
-                print(json.dumps({"diagnostic": f"{cfg.__name__} failed", "error": str(e)[:500]}), file=sys.stderr)
+    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6}
+    if "--config" in sys.argv:
+        wanted = [extra[sys.argv[sys.argv.index("--config") + 1]]]
+    elif "--all" in sys.argv:
+        wanted = list(extra.values())
+    else:
+        wanted = []
+    for cfg in wanted:
+        try:
+            cfg()
+        except Exception as e:  # noqa: BLE001 — keep later configs running
+            print(json.dumps({"diagnostic": f"{cfg.__name__} failed", "error": str(e)[:500]}), file=sys.stderr)
 
 
 if __name__ == "__main__":

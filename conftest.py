@@ -1,0 +1,15 @@
+"""Repo-root pytest config: pin the virtual CPU platform for EVERY pytest
+invocation, including ``--doctest-modules metrics_tpu`` where the tests/
+conftest is not on the collection path. Without the pin, the preloaded jax
+tries the ambient axon TPU plugin (PYTHONPATH site preload), which can hang
+collection when the tunnel is unreachable. See tests/conftest.py."""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -72,6 +72,15 @@ class CatBuffer:
             raise MetricsTPUUserError(
                 f"Batch of {n} rows exceeds CatBuffer capacity {self.capacity}."
             )
+        if batch.shape[1:] != self.buffer.shape[1:]:
+            # the item spec freezes at the first append (and persists through
+            # reset() — defaults materialize); be loud instead of letting
+            # dynamic_update_slice fail opaquely
+            raise MetricsTPUUserError(
+                f"CatBuffer item shape mismatch: buffer holds {self.buffer.shape[1:]} "
+                f"rows but got {batch.shape[1:]}. One metric instance cannot mix "
+                "item shapes; create a fresh metric for differently-shaped inputs."
+            )
         if not _is_traced(self.count):
             if int(self.count) + n > self.capacity:
                 raise MetricsTPUUserError(

@@ -773,7 +773,24 @@ def _wrap_update(update: Callable) -> Callable:
             )
         self._computed = None
         self._update_called = True
-        return update(self, *args, **kwargs)
+        out = update(self, *args, **kwargs)
+        # once an update has fixed a CatBuffer's item shape/dtype, materialize
+        # the DEFAULT too (zero-filled, count 0): init_state() then returns a
+        # carry with stable pytree structure, so fresh states thread straight
+        # through lax.scan without a warm-up pure_update outside the loop
+        for name, d in self._defaults.items():
+            if isinstance(d, CatBuffer) and d.buffer is None:
+                live = self._state.get(name)
+                if isinstance(live, CatBuffer) and live.buffer is not None:
+                    # numpy zeros, NOT jnp: shape/dtype are static even when
+                    # `live.buffer` is a tracer (first update ran inside jit),
+                    # and a jnp.zeros here would bind to the ambient trace and
+                    # leak a tracer into the defaults
+                    self._defaults[name] = CatBuffer(
+                        d.capacity,
+                        buffer=np.zeros(live.buffer.shape, live.buffer.dtype),
+                    )
+        return out
 
     wrapped_func._wrapped = True  # type: ignore[attr-defined]
     return wrapped_func
@@ -791,7 +808,9 @@ def _wrap_compute(compute: Callable) -> Callable:
             )
         if self._computed is not None:
             return self._computed
-        is_tracing = any(
+        from metrics_tpu.utils.checks import _tracing_active
+
+        is_tracing = _tracing_active() or any(
             isinstance(leaf, jax.core.Tracer) for leaf in jax.tree_util.tree_leaves(self._state)
         )
         should = self._to_sync and self._is_synced is False and not is_tracing

@@ -35,12 +35,14 @@ def _high_dtype():
     return jax.dtypes.canonicalize_dtype(jnp.float64)
 
 
-def _compute_fid(mu1: Array, sigma1: Array, mu2: Array, sigma2: Array) -> Array:
+def _compute_fid(
+    mu1: Array, sigma1: Array, mu2: Array, sigma2: Array, sqrtm_method: str = "auto"
+) -> Array:
     r"""Fréchet distance between N(mu1, sigma1) and N(mu2, sigma2):
     ``||mu1-mu2||^2 + Tr(sigma1 + sigma2 - 2 sqrt(sigma1 sigma2))``
     (reference ``fid.py:96-123``)."""
     diff = mu1 - mu2
-    tr_covmean = trace_sqrtm_product(sigma1, sigma2)
+    tr_covmean = trace_sqrtm_product(sigma1, sigma2, method=sqrtm_method)
     return diff @ diff + jnp.trace(sigma1) + jnp.trace(sigma2) - 2 * tr_covmean
 
 
@@ -87,12 +89,16 @@ class FID(Metric):
         weights: Optional[Any] = None,
         streaming: bool = False,
         feature_dim: Optional[int] = None,
+        sqrtm_method: str = "auto",
         compute_on_step: bool = False,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
     ) -> None:
         super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        # 'auto' = Newton-Schulz on TPU (matmul-only: seconds of compile vs
+        # ~100 s/eigh), eigh elsewhere; see ops/linalg.trace_sqrtm_product
+        self.sqrtm_method = sqrtm_method
         if callable(feature):
             self.inception = feature
             feat_dim = feature_dim
@@ -180,4 +186,4 @@ class FID(Metric):
             fake = dim_zero_cat(self.fake_features).astype(_high_dtype())
             mean1, cov1 = _mean_cov(real)
             mean2, cov2 = _mean_cov(fake)
-        return _compute_fid(mean1, cov1, mean2, cov2).astype(jnp.float32)
+        return _compute_fid(mean1, cov1, mean2, cov2, self.sqrtm_method).astype(jnp.float32)

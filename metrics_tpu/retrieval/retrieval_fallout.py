@@ -32,6 +32,7 @@ class RetrievalFallOut(RetrievalMetric):
         self,
         empty_target_action: str = "pos",
         k: Optional[int] = None,
+        num_queries: Optional[int] = None,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -39,6 +40,7 @@ class RetrievalFallOut(RetrievalMetric):
     ) -> None:
         super().__init__(
             empty_target_action=empty_target_action,
+            num_queries=num_queries,
             compute_on_step=compute_on_step,
             dist_sync_on_step=dist_sync_on_step,
             process_group=process_group,

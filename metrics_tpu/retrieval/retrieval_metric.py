@@ -18,7 +18,7 @@ from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.segment import GroupedByQuery, group_by_query, segment_sum
-from metrics_tpu.utils.checks import _check_retrieval_inputs
+from metrics_tpu.utils.checks import _check_retrieval_inputs, _is_concrete
 from metrics_tpu.utils.data import dim_zero_cat
 
 
@@ -35,6 +35,7 @@ class RetrievalMetric(Metric, ABC):
     def __init__(
         self,
         empty_target_action: str = "neg",
+        num_queries: Optional[int] = None,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -50,6 +51,16 @@ class RetrievalMetric(Metric, ABC):
         if empty_target_action not in empty_target_action_options:
             raise ValueError(f"Argument `empty_target_action` received a wrong value `{empty_target_action}`.")
         self.empty_target_action = empty_target_action
+        # static upper bound on distinct query ids: makes `compute` fully
+        # jittable (segment counts become compile-time constants); group ids
+        # beyond the data are masked out of the mean. TPU-native analogue of
+        # the reference's data-derived group count (`utilities/data.py:203`).
+        if num_queries is not None and empty_target_action == "error":
+            raise ValueError(
+                "`empty_target_action='error'` needs a host-side check and is "
+                "incompatible with the jittable `num_queries` mode."
+            )
+        self.num_queries = num_queries
 
         self.add_state("indexes", default=[], dist_reduce_fx=None)
         self.add_state("preds", default=[], dist_reduce_fx=None)
@@ -61,6 +72,14 @@ class RetrievalMetric(Metric, ABC):
         indexes, preds, target = _check_retrieval_inputs(
             indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target
         )
+        if self.num_queries is not None and _is_concrete(indexes):
+            top = int(jnp.max(indexes))
+            if top >= self.num_queries:
+                # segment ops would silently DROP the out-of-range groups
+                raise ValueError(
+                    f"`num_queries={self.num_queries}` is a static upper bound, but "
+                    f"query id {top} was seen; raise `num_queries` above the largest id."
+                )
         self.indexes.append(indexes)
         self.preds.append(preds)
         self.target.append(target)
@@ -72,7 +91,7 @@ class RetrievalMetric(Metric, ABC):
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
 
-        g = group_by_query(indexes, preds, target)
+        g = group_by_query(indexes, preds, target, num_groups=self.num_queries)
         scores = self._segment_metric(g)  # [G]
 
         if self.empty_on_negatives:
@@ -80,17 +99,22 @@ class RetrievalMetric(Metric, ABC):
         else:
             empty = segment_sum((g.target > 0).astype(jnp.int32), g) == 0
 
+        # with a static `num_queries` upper bound, group ids beyond the data
+        # are empty padding segments: mask them out of every reduction
+        present = g.group_sizes > 0
+
         if self.empty_target_action == "error":
-            if bool(jnp.any(empty)):
+            if bool(jnp.any(empty & present)):
                 kind = "negative" if self.empty_on_negatives else "positive"
                 raise ValueError(f"`compute` method was provided with a query with no {kind} target.")
             return jnp.mean(scores)
         if self.empty_target_action == "skip":
-            valid = ~empty
+            valid = ~empty & present
             n_valid = jnp.sum(valid)
             return jnp.where(n_valid == 0, 0.0, jnp.sum(jnp.where(valid, scores, 0.0)) / jnp.maximum(n_valid, 1))
         fill = 1.0 if self.empty_target_action == "pos" else 0.0
-        return jnp.mean(jnp.where(empty, fill, scores))
+        n_present = jnp.maximum(jnp.sum(present), 1)
+        return jnp.sum(jnp.where(present, jnp.where(empty, fill, scores), 0.0)) / n_present
 
     @abstractmethod
     def _segment_metric(self, g: GroupedByQuery) -> Array:

@@ -28,6 +28,7 @@ class RetrievalRecall(RetrievalMetric):
         self,
         empty_target_action: str = "neg",
         k: Optional[int] = None,
+        num_queries: Optional[int] = None,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -35,6 +36,7 @@ class RetrievalRecall(RetrievalMetric):
     ) -> None:
         super().__init__(
             empty_target_action=empty_target_action,
+            num_queries=num_queries,
             compute_on_step=compute_on_step,
             dist_sync_on_step=dist_sync_on_step,
             process_group=process_group,

@@ -22,9 +22,26 @@ from metrics_tpu.utils.data import select_topk, to_onehot
 from metrics_tpu.utils.enums import DataType
 
 
+try:  # 161 ns trace-context check; private, so fall back to a probe op
+    from jax._src.core import EvalTrace as _EvalTrace, trace_ctx as _trace_ctx
+
+    def _tracing_active() -> bool:
+        return not isinstance(_trace_ctx.trace, _EvalTrace)
+
+except ImportError:  # pragma: no cover - older/newer jax layout
+
+    def _tracing_active() -> bool:
+        return isinstance(jnp.zeros(()) + 0, jax.core.Tracer)
+
+
 def _is_concrete(*arrays: Array) -> bool:
-    """True when no argument is a tracer (i.e. we are running eagerly)."""
-    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+    """True when running eagerly: no argument is a tracer AND no trace is
+    ambient. The second condition matters for jit/scan over closure-constant
+    inputs — the arguments look concrete, but any op on them binds to the
+    ambient trace, so value-dependent validation would blow up on `int()`."""
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        return False
+    return not _tracing_active()
 
 
 def _is_floating(x: Array) -> bool:

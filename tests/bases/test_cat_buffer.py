@@ -144,8 +144,10 @@ def test_jit_accumulation_no_retrace():
     state = m.init_state()
     for i in range(NUM_BATCHES):
         state = step(state, jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
-    # trace 1: empty buffer (None leaf) materializes; trace 2: steady state
-    assert traces[0] == 2
+    # ONE trace: after the eager warm-up fixed the item spec, init_state()
+    # returns a materialized zero-filled buffer, so the first jitted step
+    # already has the steady-state carry structure
+    assert traces[0] == 1
     np.testing.assert_allclose(
         float(m.pure_compute(state)), _sk_auroc(_preds, _target), atol=1e-6
     )
@@ -353,3 +355,64 @@ def test_reset_restores_empty_capacity():
     np.testing.assert_allclose(
         float(m.compute()), _sk_auroc(_preds[0], _target[0]), atol=1e-6
     )
+
+
+def test_fresh_state_scans_after_item_shape_known():
+    """Once any update has fixed a CatBuffer's item spec, init_state() must
+    return a MATERIALIZED (zero-filled, count-0) buffer so a fresh state
+    threads through lax.scan — the carry pytree structure cannot change
+    between input and output (closure-constant eval-loop pattern)."""
+    from jax import lax
+
+    from metrics_tpu import AUROC
+
+    m = AUROC().with_capacity(256)
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(4, 32).astype(np.float32))
+    target = jnp.asarray((rng.rand(4, 32) > 0.5).astype(np.int32))
+    m.update(preds[0], target[0])  # fixes item shape/dtype
+    m.reset()
+
+    state0 = m.init_state()
+    assert state0["preds"].buffer is not None and int(state0["preds"].count) == 0
+
+    @jax.jit
+    def epoch(s0):
+        def body(s, xt):
+            p, t = xt
+            return m.pure_update(s, p, t), None
+        return lax.scan(body, s0, (preds, target))[0]
+
+    final = epoch(state0)
+    assert int(final["preds"].count) == 128
+    from sklearn.metrics import roc_auc_score
+
+    exp = roc_auc_score(np.asarray(target).reshape(-1), np.asarray(preds).reshape(-1))
+    np.testing.assert_allclose(float(m.pure_compute(final)), exp, atol=1e-6)
+
+
+def test_first_update_inside_jit_no_tracer_leak():
+    """First update under jit (no eager warm-up): the default materialization
+    must not leak the traced buffer into the metric's defaults — later
+    init_state()/updates would raise UnexpectedTracerError."""
+    from metrics_tpu import AUROC
+
+    m = AUROC().with_capacity(64)
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.rand(16).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, 2, (16,)))
+    state = jax.jit(m.pure_update)(m.init_state(), p, t)
+    # default is materialized from STATIC metadata, not the traced array
+    d = m.init_state()["preds"]
+    assert d.buffer is not None and not isinstance(d.buffer, jax.core.Tracer)
+    state = jax.jit(m.pure_update)(state, p, t)
+    assert int(state["preds"].count) == 32
+
+
+def test_append_shape_mismatch_is_loud():
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
+    buf = CatBuffer(16)
+    buf.append(jnp.zeros((2, 3)))
+    with pytest.raises(MetricsTPUUserError, match="item shape mismatch"):
+        buf.append(jnp.zeros((2, 4)))

@@ -1,6 +1,7 @@
 """Retrieval metrics: vectorized segment compute vs per-query numpy references
 (sklearn average_precision / ndcg + hand-rolled), mirroring the reference's
 `tests/retrieval/` strategy."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -225,3 +226,51 @@ def test_retrieval_merge_across_instances():
     b.update(jnp.asarray(preds[150:]), jnp.asarray(target[150:]), jnp.asarray(indexes[150:]))
     a.merge_state(b)
     np.testing.assert_allclose(np.asarray(a.compute()), np.asarray(full.compute()), atol=1e-6)
+
+
+class TestStaticNumQueries:
+    """`num_queries` static upper bound: compute becomes one jittable XLA
+    program; padding group ids are masked out of every policy's mean."""
+
+    def _data(self, rng, n=512, queries=37):
+        idx = jnp.asarray(rng.randint(0, queries, (n,)))
+        preds = jnp.asarray(rng.rand(n).astype(np.float32))
+        target = jnp.asarray(rng.randint(0, 2, (n,)))
+        return idx, preds, target
+
+    @pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+    def test_matches_eager_data_derived_count(self, action):
+        rng = np.random.RandomState(7)
+        idx, preds, target = self._data(rng)
+        for cls in (RetrievalMAP, RetrievalMRR, RetrievalNormalizedDCG):
+            eager = cls(empty_target_action=action)
+            eager.update(preds, target, indexes=idx)
+            exp = float(eager.compute())
+
+            static = cls(empty_target_action=action, num_queries=64)  # > 37: padding
+            state = static.pure_update(static.init_state(), preds, target, indexes=idx)
+            got = jax.jit(static.pure_compute)(state)
+            np.testing.assert_allclose(float(got), exp, atol=1e-6)
+
+    def test_jit_compiles_once_and_caches(self):
+        rng = np.random.RandomState(8)
+        m = RetrievalMAP(num_queries=64)
+        compute = jax.jit(m.pure_compute)
+        vals = []
+        for _ in range(2):
+            idx, preds, target = self._data(rng)
+            state = m.pure_update(m.init_state(), preds, target, indexes=idx)
+            vals.append(float(compute(state)))
+        assert compute._cache_size() == 1  # same shapes -> one trace
+        assert vals[0] != vals[1]  # but genuinely different data
+
+    def test_error_action_rejected(self):
+        with pytest.raises(ValueError, match="num_queries"):
+            RetrievalMAP(empty_target_action="error", num_queries=8)
+
+
+def test_num_queries_too_small_raises_eagerly():
+    m = RetrievalMAP(num_queries=4)
+    idx = jnp.asarray([0, 1, 2, 9])
+    with pytest.raises(ValueError, match="static upper bound"):
+        m.update(jnp.asarray([0.1, 0.2, 0.3, 0.4]), jnp.asarray([1, 0, 1, 0]), indexes=idx)
