@@ -605,8 +605,10 @@ class Metric:
             return x
 
         self._dtype = dtype
-        self._restore(apply_to_collection(self._state, (jnp.ndarray,), cast))
-        self._defaults = apply_to_collection(self._defaults, (jnp.ndarray,), cast)
+        # np.ndarray included: materialized CatBuffer defaults are numpy
+        # (tracer-safe), and missing them here would revert the cast on reset
+        self._restore(apply_to_collection(self._state, (jnp.ndarray, np.ndarray), cast))
+        self._defaults = apply_to_collection(self._defaults, (jnp.ndarray, np.ndarray), cast)
         return self
 
     # pickling: jnp arrays pickle via numpy

@@ -97,7 +97,13 @@ class FID(Metric):
     ) -> None:
         super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
         # 'auto' = Newton-Schulz on TPU (matmul-only: seconds of compile vs
-        # ~100 s/eigh), eigh elsewhere; see ops/linalg.trace_sqrtm_product
+        # ~100 s/eigh), eigh elsewhere; see ops/linalg.trace_sqrtm_product.
+        # Validate NOW: an epoch of feature extraction must not be wasted on
+        # a typo that would only surface at compute()
+        if sqrtm_method not in ("auto", "eigh", "ns"):
+            raise ValueError(
+                f"unknown sqrtm method {sqrtm_method!r}; use 'auto', 'eigh' or 'ns'"
+            )
         self.sqrtm_method = sqrtm_method
         if callable(feature):
             self.inception = feature

@@ -40,6 +40,17 @@ def group_by_query(indexes: Array, preds: Array, target: Array, num_groups: Opti
     gid = jnp.cumsum(new_group) - 1
     if num_groups is None:
         num_groups = int(gid[-1]) + 1 if idx_s.size else 0
+    elif idx_s.size and not isinstance(gid, jax.core.Tracer):
+        # static bound with concrete data: gids are DENSE 0-based group ids
+        # (cumsum of boundaries), so the bound constrains the number of
+        # DISTINCT query ids, not their magnitude. Out-of-range groups would
+        # be silently dropped by the segment ops — be loud while we can.
+        actual = int(gid[-1]) + 1
+        if actual > num_groups:
+            raise ValueError(
+                f"`num_queries={num_groups}` is a static upper bound on DISTINCT "
+                f"query ids, but the data holds {actual} distinct ids; raise it."
+            )
 
     positions = jnp.arange(idx_s.shape[0])
     group_start = jax.ops.segment_min(positions, gid, num_segments=num_groups)

@@ -72,14 +72,6 @@ class RetrievalMetric(Metric, ABC):
         indexes, preds, target = _check_retrieval_inputs(
             indexes, preds, target, allow_non_binary_target=self.allow_non_binary_target
         )
-        if self.num_queries is not None and _is_concrete(indexes):
-            top = int(jnp.max(indexes))
-            if top >= self.num_queries:
-                # segment ops would silently DROP the out-of-range groups
-                raise ValueError(
-                    f"`num_queries={self.num_queries}` is a static upper bound, but "
-                    f"query id {top} was seen; raise `num_queries` above the largest id."
-                )
         self.indexes.append(indexes)
         self.preds.append(preds)
         self.target.append(target)

@@ -416,3 +416,18 @@ def test_append_shape_mismatch_is_loud():
     buf.append(jnp.zeros((2, 3)))
     with pytest.raises(MetricsTPUUserError, match="item shape mismatch"):
         buf.append(jnp.zeros((2, 4)))
+
+
+def test_set_dtype_survives_reset():
+    """set_dtype must cast the materialized (numpy) defaults too — reset()
+    would otherwise silently revert the buffer dtype."""
+    from metrics_tpu import AUROC
+
+    m = AUROC().with_capacity(32)
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m.set_dtype(jnp.float16)
+    assert m.preds.buffer.dtype == jnp.float16
+    m.reset()
+    assert np.dtype(m.init_state()["preds"].buffer.dtype) == np.float16
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    assert m.preds.buffer.dtype == jnp.float16

@@ -6,6 +6,7 @@ matrix: every fixture variant drives the stat-scores family end to end
 (eager + ddp-merge + sharded mesh), with hand-numpy references composed after
 the shared input formatting (the existing `_sk_accuracy` strategy).
 """
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -176,3 +177,24 @@ def test_ignore_index_macro_drops_class(ignore_index, metric_class):
     keep = np.ones(NUM_CLASSES, bool)
     keep[ignore_index] = False
     np.testing.assert_allclose(got, np.nanmean(per_class[keep]), atol=1e-6)
+
+
+def test_select_topk_nan_row_keeps_one_hot_invariant():
+    """A NaN score row must still produce exactly one prediction (lax.top_k
+    ranks NaN highest); the k=1 comparison path must not zero the row."""
+    from metrics_tpu.utils.data import select_topk
+
+    x = jnp.asarray([[0.1, np.nan, 0.3], [0.5, 0.2, 0.1], [np.nan, np.nan, 0.0]])
+    got = np.asarray(select_topk(x, 1))
+    ref = np.zeros_like(got)
+    idx = np.asarray(jax.lax.top_k(x, 1)[1][:, 0])
+    ref[np.arange(3), idx] = 1
+    np.testing.assert_array_equal(got, ref)
+    assert (got.sum(1) == 1).all()
+
+
+def test_fid_sqrtm_method_validated_at_init():
+    from metrics_tpu import FID
+
+    with pytest.raises(ValueError, match="unknown sqrtm method"):
+        FID(feature=lambda x: x, feature_dim=8, streaming=True, sqrtm_method="newton")

@@ -269,8 +269,29 @@ class TestStaticNumQueries:
             RetrievalMAP(empty_target_action="error", num_queries=8)
 
 
-def test_num_queries_too_small_raises_eagerly():
-    m = RetrievalMAP(num_queries=4)
-    idx = jnp.asarray([0, 1, 2, 9])
-    with pytest.raises(ValueError, match="static upper bound"):
-        m.update(jnp.asarray([0.1, 0.2, 0.3, 0.4]), jnp.asarray([1, 0, 1, 0]), indexes=idx)
+
+
+def test_num_queries_bounds_distinct_ids_not_magnitude():
+    """Non-contiguous / hash-like query ids are fine: the static bound
+    constrains the number of DISTINCT ids (dense gids), and a genuinely
+    too-small bound raises eagerly at compute instead of silently dropping."""
+    m = RetrievalMAP(num_queries=2)
+    m.update(
+        jnp.asarray([0.9, 0.1, 0.8, 0.2]),
+        jnp.asarray([1, 0, 0, 1]),
+        indexes=jnp.asarray([1000, 1000, 5001, 5001]),
+    )
+    eager = RetrievalMAP()
+    eager.update(
+        jnp.asarray([0.9, 0.1, 0.8, 0.2]),
+        jnp.asarray([1, 0, 0, 1]),
+        indexes=jnp.asarray([1000, 1000, 5001, 5001]),
+    )
+    np.testing.assert_allclose(float(m.compute()), float(eager.compute()), atol=1e-6)
+
+    too_small = RetrievalMAP(num_queries=2)
+    too_small.update(
+        jnp.asarray([0.9, 0.1, 0.8]), jnp.asarray([1, 0, 1]), indexes=jnp.asarray([0, 1, 2])
+    )
+    with pytest.raises(ValueError, match="DISTINCT"):
+        too_small.compute()
