@@ -18,7 +18,7 @@ from jax import Array
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.ops.segment import GroupedByQuery, group_by_query, segment_sum
-from metrics_tpu.utils.checks import _check_retrieval_inputs, _is_concrete
+from metrics_tpu.utils.checks import _check_retrieval_inputs
 from metrics_tpu.utils.data import dim_zero_cat
 
 

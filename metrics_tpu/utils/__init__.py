@@ -11,6 +11,7 @@ from metrics_tpu.utils.data import (
     to_categorical,
     to_onehot,
 )
+from metrics_tpu.utils import compile_cache
 from metrics_tpu.utils.enums import AverageMethod, DataType, EnumStr, MDMCAverageMethod
 from metrics_tpu.utils.exceptions import MetricsTPUUserError, TorchMetricsUserError
 from metrics_tpu.utils.prints import (

@@ -1,0 +1,47 @@
+"""Persistent XLA compilation cache helper.
+
+First-call latency is the one real cost of the all-in-one-XLA-program design:
+heavy computes (Inception forward, BERT, eigh-path FID) compile for seconds
+to minutes per process (measured: ~108 s per ``eigh`` instance on a TPU
+backend — ``docs/performance.md``). JAX ships a persistent on-disk cache;
+this helper turns it on with sane defaults so the cost is paid once per
+machine instead of once per process.
+
+Usage::
+
+    import metrics_tpu
+    metrics_tpu.utils.compile_cache.enable()          # ~/.cache/metrics_tpu/xla
+    metrics_tpu.utils.compile_cache.enable("/fast/disk/xla-cache")
+
+Call it before the first jit compilation. No-op (with a warning) if jax is
+too old to support the config knobs.
+"""
+import os
+from typing import Optional
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+DEFAULT_DIR = os.path.join(
+    os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "metrics_tpu", "xla"
+)
+
+
+def enable(cache_dir: Optional[str] = None, min_compile_seconds: float = 1.0) -> str:
+    """Enable jax's persistent compilation cache; returns the cache dir.
+
+    Programs whose compile takes less than ``min_compile_seconds`` are not
+    cached (they are cheaper to recompile than to hash + deserialize).
+    """
+    import jax
+
+    path = os.path.abspath(cache_dir or DEFAULT_DIR)
+    os.makedirs(path, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", float(min_compile_seconds))
+        # cache regardless of backend (CPU included): useful for the virtual
+        # CPU meshes used in tests/CI, not just the TPU
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "all")
+    except AttributeError as err:  # pragma: no cover - jax without the knobs
+        rank_zero_warn(f"persistent compilation cache unavailable in this jax: {err}")
+    return path

@@ -78,14 +78,10 @@ def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
     """
     moved = jnp.moveaxis(prob_tensor, dim, -1)
     if topk == 1:
-        is_nan = jnp.isnan(moved)
-        # NaN scores must keep lax.top_k's total order (NaN ranks highest):
-        # `== max` alone would return an all-zero row and silently break the
-        # one-hot-per-row invariant downstream
-        mask = ((moved == jnp.max(moved, axis=-1, keepdims=True)) | is_nan).astype(jnp.int32)
-        # exact ties would mark several columns; keep only the FIRST winner
-        # (lax.top_k tie-breaking) via a cumulative guard
-        mask = mask * (jnp.cumsum(mask, axis=-1) == 1)
+        # argmax matches lax.top_k's total order exactly (first NaN position
+        # if any NaN, else first max on ties) without the sort that made this
+        # the hot path's dominant cost
+        mask = jax.nn.one_hot(jnp.argmax(moved, axis=-1), moved.shape[-1], dtype=jnp.int32)
     else:
         _, idx = jax.lax.top_k(moved, topk)
         mask = jnp.zeros(moved.shape, dtype=jnp.int32)

@@ -1,0 +1,22 @@
+"""The sharded-eval example (docs/distributed.md companion) must run and
+match the single-device reference — it is the acceptance demo for the
+distributed story."""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+
+def test_example_runs_and_matches():
+    repo = Path(__file__).resolve().parents[2]
+    r = subprocess.run(
+        [sys.executable, str(repo / "examples" / "sharded_eval.py")],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env={**os.environ,
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+             "PYTHONPATH": str(repo)},
+    )
+    assert r.returncode == 0, r.stderr[-1500:]
+    assert "matches single-device reference" in r.stdout

@@ -1,0 +1,52 @@
+"""Persistent compilation cache: enabling it must actually write cache
+entries that a second process can hit (the eigh/Inception compile cost is
+paid once per machine, not per process)."""
+import os
+import subprocess
+import sys
+
+CHILD = """
+import sys, time
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+from metrics_tpu.utils import compile_cache
+compile_cache.enable({cache!r}, min_compile_seconds=0.0)
+import jax.numpy as jnp
+import numpy as np
+t0 = time.perf_counter()
+# a compile that is unique to this test but identical across both children
+f = jax.jit(lambda x: jnp.tanh(x @ x.T) * 1.25 + jnp.cos(x).sum())
+out = f(jnp.arange(64.0).reshape(8, 8))
+out.block_until_ready()
+print("COMPILE_S", time.perf_counter() - t0)
+"""
+
+
+def test_cache_dir_populated_and_hit(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    cache = str(tmp_path / "xla")
+    code = CHILD.format(repo=repo, cache=cache)
+    r1 = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, timeout=240)
+    assert r1.returncode == 0, r1.stderr[-800:]
+    entries = []
+    for root, _, files in os.walk(cache):
+        entries += files
+    assert entries, "cache dir is empty after a jit compile"
+    r2 = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, timeout=240)
+    assert r2.returncode == 0, r2.stderr[-800:]
+
+
+def test_enable_returns_default_dir(monkeypatch, tmp_path):
+    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
+    import importlib
+
+    from metrics_tpu.utils import compile_cache
+
+    importlib.reload(compile_cache)
+    try:
+        got = compile_cache.enable()
+        assert got.startswith(str(tmp_path))
+        assert os.path.isdir(got)
+    finally:
+        importlib.reload(compile_cache)  # restore module-level default
