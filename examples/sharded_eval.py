@@ -45,7 +45,7 @@ def main() -> None:
     metrics = MetricCollection(
         {
             "acc": Accuracy(num_classes=2),
-            "auroc": AUROC(num_classes=2).with_capacity(eval_rows),  # static per-device buffer
+            "auroc": AUROC(num_classes=2).with_capacity(eval_rows // n_dev),  # per-DEVICE rows
         }
     )
     # one eager batch warms input-mode detection + materializes buffer specs

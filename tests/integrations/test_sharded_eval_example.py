@@ -16,7 +16,7 @@ def test_example_runs_and_matches():
         timeout=420,
         env={**os.environ,
              "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
-             "PYTHONPATH": str(repo)},
+             "PYTHONPATH": str(repo) + os.pathsep + os.environ.get("PYTHONPATH", "")},
     )
     assert r.returncode == 0, r.stderr[-1500:]
     assert "matches single-device reference" in r.stdout

@@ -1,6 +1,7 @@
 """Persistent compilation cache: enabling it must actually write cache
 entries that a second process can hit (the eigh/Inception compile cost is
-paid once per machine, not per process)."""
+paid once per machine, not per process). Everything runs in subprocesses so
+the process-wide jax cache config never leaks into this test session."""
 import os
 import subprocess
 import sys
@@ -22,31 +23,44 @@ out.block_until_ready()
 print("COMPILE_S", time.perf_counter() - t0)
 """
 
+DEFAULT_CHILD = """
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ["XDG_CACHE_HOME"] = {xdg!r}
+import jax
+jax.config.update("jax_platforms", "cpu")
+from metrics_tpu.utils import compile_cache
+print("DIR", compile_cache.enable())
+"""
 
-def test_cache_dir_populated_and_hit(tmp_path):
-    repo = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _entries(cache):
+    found = []
+    for root, _, files in os.walk(cache):
+        found += [os.path.join(root, f) for f in files]
+    return sorted(found)
+
+
+def test_cache_dir_populated_and_second_process_hits(tmp_path):
     cache = str(tmp_path / "xla")
-    code = CHILD.format(repo=repo, cache=cache)
+    code = CHILD.format(repo=REPO, cache=cache)
     r1 = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, timeout=240)
     assert r1.returncode == 0, r1.stderr[-800:]
-    entries = []
-    for root, _, files in os.walk(cache):
-        entries += files
-    assert entries, "cache dir is empty after a jit compile"
+    after_first = _entries(cache)
+    assert after_first, "cache dir is empty after a jit compile"
     r2 = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, timeout=240)
     assert r2.returncode == 0, r2.stderr[-800:]
+    # a HIT writes nothing new: identical program -> identical key -> reuse
+    assert _entries(cache) == after_first, "second process recompiled instead of hitting the cache"
 
 
-def test_enable_returns_default_dir(monkeypatch, tmp_path):
-    monkeypatch.setenv("XDG_CACHE_HOME", str(tmp_path))
-    import importlib
-
-    from metrics_tpu.utils import compile_cache
-
-    importlib.reload(compile_cache)
-    try:
-        got = compile_cache.enable()
-        assert got.startswith(str(tmp_path))
-        assert os.path.isdir(got)
-    finally:
-        importlib.reload(compile_cache)  # restore module-level default
+def test_enable_returns_default_dir(tmp_path):
+    code = DEFAULT_CHILD.format(repo=REPO, xdg=str(tmp_path))
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr[-800:]
+    line = [ln for ln in r.stdout.splitlines() if ln.startswith("DIR ")][0]
+    got = line[4:]
+    assert got.startswith(str(tmp_path))
+    assert os.path.isdir(got)
