@@ -119,7 +119,16 @@ class BinnedPrecisionRecallCurve(Metric):
 
 
 class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
-    """Constant-memory average precision from binned PR pairs."""
+    """Constant-memory average precision from binned PR pairs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedAveragePrecision
+        >>> m = BinnedAveragePrecision(num_classes=1, thresholds=5)
+        >>> m.update(jnp.asarray([0.1, 0.85, 0.4, 0.8]), jnp.asarray([0, 1, 0, 1]))
+        >>> print(round(float(m.compute()), 4))
+        1.0
+    """
 
     def compute(self) -> Union[List[Array], Array]:  # type: ignore[override]
         precisions, recalls, _ = super().compute()
@@ -129,7 +138,17 @@ class BinnedAveragePrecision(BinnedPrecisionRecallCurve):
 
 
 class BinnedRecallAtFixedPrecision(BinnedPrecisionRecallCurve):
-    """Highest recall at a minimum precision, from binned PR pairs."""
+    """Highest recall at a minimum precision, from binned PR pairs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import BinnedRecallAtFixedPrecision
+        >>> m = BinnedRecallAtFixedPrecision(num_classes=1, min_precision=0.5, thresholds=5)
+        >>> m.update(jnp.asarray([0.1, 0.85, 0.4, 0.8]), jnp.asarray([0, 1, 0, 1]))
+        >>> recall, threshold = m.compute()
+        >>> print(round(float(recall), 4), round(float(threshold), 2))
+        1.0 0.75
+    """
 
     def __init__(
         self,

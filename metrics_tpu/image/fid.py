@@ -81,6 +81,17 @@ class FID(Metric):
             exactly equivalent mean/cov, recommended on TPU.
         feature_dim: feature dimensionality, required for ``streaming=True``
             with a callable ``feature`` (inferred from integer taps).
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from metrics_tpu import FID
+        >>> rng = np.random.RandomState(0)
+        >>> feats = lambda x: x.reshape(x.shape[0], -1)   # stand-in extractor
+        >>> fid = FID(feature=feats, feature_dim=16, streaming=True)
+        >>> fid.update(jnp.asarray(rng.rand(32, 4, 2, 2).astype(np.float32)), real=True)
+        >>> fid.update(jnp.asarray(rng.rand(32, 4, 2, 2).astype(np.float32) * 0.9 + 0.05), real=False)
+        >>> print(round(float(fid.compute()), 4))
+        0.3715
     """
 
     def __init__(

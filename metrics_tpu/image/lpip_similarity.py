@@ -31,6 +31,18 @@ class LPIPS(Metric):
         reduction: 'mean' | 'sum' over all scored pairs.
         net: optional custom callable ``(img0, img1) -> [N] distances``
             (replaces the built-in tower, e.g. one with loaded weights).
+
+    Example:
+        >>> import numpy as np, jax.numpy as jnp
+        >>> from metrics_tpu import LPIPS
+        >>> rng = np.random.RandomState(0)
+        >>> def dist_fn(x, y):                       # custom perceptual distance
+        ...     return jnp.mean((x - y) ** 2, axis=(1, 2, 3))
+        >>> lpips = LPIPS(net=dist_fn)
+        >>> a = jnp.asarray(rng.rand(2, 3, 8, 8).astype(np.float32)) * 2 - 1
+        >>> b = jnp.asarray(rng.rand(2, 3, 8, 8).astype(np.float32)) * 2 - 1
+        >>> print(round(float(lpips(a, b)), 4))
+        0.6495
     """
 
     is_differentiable = True

@@ -11,7 +11,16 @@ from metrics_tpu.utils.prints import rank_zero_deprecation
 
 
 class R2Score(_R2Score):
-    """Deprecated alias of :class:`metrics_tpu.regression.r2.R2Score`."""
+    """Deprecated alias of :class:`metrics_tpu.regression.r2.R2Score`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.regression.r2score import R2Score
+        >>> r2 = R2Score()
+        >>> r2.update(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))
+        >>> print(round(float(r2.compute()), 4))
+        0.9486
+    """
 
     def __init__(self, *args: Any, **kwargs: Any) -> None:
         rank_zero_deprecation(
