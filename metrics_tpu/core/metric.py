@@ -45,6 +45,38 @@ from metrics_tpu.utils.prints import rank_zero_warn
 _MERGEABLE_FX = ("sum", "cat", "max", "min")
 
 
+# module-level named wrappers: picklable (unlike jnp's ufunc wrapper objects
+# and lambdas) while keeping jnp's argument validation — operator.* would
+# silently concatenate tuple-returning computes instead of erroring
+def _jadd(a, b): return jnp.add(a, b)                # noqa: E704
+def _jsub(a, b): return jnp.subtract(a, b)           # noqa: E704
+def _jmul(a, b): return jnp.multiply(a, b)           # noqa: E704
+def _jdiv(a, b): return jnp.true_divide(a, b)        # noqa: E704
+def _jfloordiv(a, b): return jnp.floor_divide(a, b)  # noqa: E704
+def _jmod(a, b): return jnp.mod(a, b)                # noqa: E704
+def _jpow(a, b): return jnp.power(a, b)              # noqa: E704
+def _jmatmul(a, b): return jnp.matmul(a, b)          # noqa: E704
+def _jand(a, b): return jnp.bitwise_and(a, b)        # noqa: E704
+def _jor(a, b): return jnp.bitwise_or(a, b)          # noqa: E704
+def _jxor(a, b): return jnp.bitwise_xor(a, b)        # noqa: E704
+def _jeq(a, b): return jnp.equal(a, b)               # noqa: E704
+def _jne(a, b): return jnp.not_equal(a, b)           # noqa: E704
+def _jlt(a, b): return jnp.less(a, b)                # noqa: E704
+def _jle(a, b): return jnp.less_equal(a, b)          # noqa: E704
+def _jgt(a, b): return jnp.greater(a, b)             # noqa: E704
+def _jge(a, b): return jnp.greater_equal(a, b)       # noqa: E704
+def _jabs(x): return jnp.abs(x)                      # noqa: E704
+def _jneg(x): return jnp.negative(x)                 # noqa: E704
+
+
+def _logical_not(x: Any) -> Any:
+    return jnp.logical_not(x)
+
+
+def _getitem(x: Any, idx: Any) -> Any:
+    return x[idx]
+
+
 def _copy_state_value(v: Any) -> Any:
     if isinstance(v, list):
         return list(v)
@@ -664,105 +696,105 @@ class Metric:
     # ------------------------------------------------------------------
 
     def __add__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.add, self, other)
+        return CompositionalMetric(_jadd, self, other)
 
     def __radd__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.add, other, self)
+        return CompositionalMetric(_jadd, other, self)
 
     def __sub__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.subtract, self, other)
+        return CompositionalMetric(_jsub, self, other)
 
     def __rsub__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.subtract, other, self)
+        return CompositionalMetric(_jsub, other, self)
 
     def __mul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.multiply, self, other)
+        return CompositionalMetric(_jmul, self, other)
 
     def __rmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.multiply, other, self)
+        return CompositionalMetric(_jmul, other, self)
 
     def __truediv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.true_divide, self, other)
+        return CompositionalMetric(_jdiv, self, other)
 
     def __rtruediv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.true_divide, other, self)
+        return CompositionalMetric(_jdiv, other, self)
 
     def __floordiv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.floor_divide, self, other)
+        return CompositionalMetric(_jfloordiv, self, other)
 
     def __rfloordiv__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.floor_divide, other, self)
+        return CompositionalMetric(_jfloordiv, other, self)
 
     def __mod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, self, other)
+        return CompositionalMetric(_jmod, self, other)
 
     def __rmod__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.mod, other, self)
+        return CompositionalMetric(_jmod, other, self)
 
     def __pow__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.power, self, other)
+        return CompositionalMetric(_jpow, self, other)
 
     def __rpow__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.power, other, self)
+        return CompositionalMetric(_jpow, other, self)
 
     def __matmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.matmul, self, other)
+        return CompositionalMetric(_jmatmul, self, other)
 
     def __rmatmul__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.matmul, other, self)
+        return CompositionalMetric(_jmatmul, other, self)
 
     def __and__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_and, self, other)
+        return CompositionalMetric(_jand, self, other)
 
     def __rand__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_and, other, self)
+        return CompositionalMetric(_jand, other, self)
 
     def __or__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_or, self, other)
+        return CompositionalMetric(_jor, self, other)
 
     def __ror__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_or, other, self)
+        return CompositionalMetric(_jor, other, self)
 
     def __xor__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_xor, self, other)
+        return CompositionalMetric(_jxor, self, other)
 
     def __rxor__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.bitwise_xor, other, self)
+        return CompositionalMetric(_jxor, other, self)
 
     def __eq__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
-        return CompositionalMetric(jnp.equal, self, other)
+        return CompositionalMetric(_jeq, self, other)
 
     def __ne__(self, other: Any) -> "CompositionalMetric":  # type: ignore[override]
-        return CompositionalMetric(jnp.not_equal, self, other)
+        return CompositionalMetric(_jne, self, other)
 
     def __lt__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.less, self, other)
+        return CompositionalMetric(_jlt, self, other)
 
     def __le__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.less_equal, self, other)
+        return CompositionalMetric(_jle, self, other)
 
     def __gt__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.greater, self, other)
+        return CompositionalMetric(_jgt, self, other)
 
     def __ge__(self, other: Any) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.greater_equal, self, other)
+        return CompositionalMetric(_jge, self, other)
 
     def __abs__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.abs, self, None)
+        return CompositionalMetric(_jabs, self, None)
 
     def __neg__(self) -> "CompositionalMetric":
-        return CompositionalMetric(lambda x: -x, self, None)
+        return CompositionalMetric(_jneg, self, None)
 
     def __pos__(self) -> "CompositionalMetric":
         # deliberately abs, NOT identity: faithful to the reference's quirk
         # (`metric.py:649-650` maps __pos__ to torch.abs) — do not "fix"
-        return CompositionalMetric(jnp.abs, self, None)
+        return CompositionalMetric(_jabs, self, None)
 
     def __invert__(self) -> "CompositionalMetric":
-        return CompositionalMetric(jnp.logical_not, self, None)
+        return CompositionalMetric(_logical_not, self, None)
 
     def __getitem__(self, idx: Any) -> "CompositionalMetric":
-        return CompositionalMetric(lambda x: x[idx], self, None)
+        return CompositionalMetric(functools.partial(_getitem, idx=idx), self, None)
 
 
 def _wrap_update(update: Callable) -> Callable:

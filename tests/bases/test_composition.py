@@ -130,3 +130,111 @@ def test_composition_reset_propagates():
 def test_nested_composition():
     res = (_c(5) + _c(2)) * 2
     np.testing.assert_allclose(np.asarray(res.compute()), 14.0)
+
+
+@pytest.mark.parametrize(
+    "op, expected",
+    [
+        (lambda s, m: s + m, 7.0),     # __radd__
+        (lambda s, m: s - m, 3.0),     # __rsub__
+        (lambda s, m: s * m, 10.0),    # __rmul__
+        (lambda s, m: s / m, 2.5),     # __rtruediv__
+        (lambda s, m: s // m, 2.0),    # __rfloordiv__
+        (lambda s, m: s % m, 1.0),     # __rmod__
+        (lambda s, m: s ** m, 25.0),   # __rpow__
+    ],
+)
+def test_reflected_arithmetic_scalar_metric(op, expected):
+    """Reference test_composition.py: scalar-op-metric hits the r-dunders."""
+    res = op(5.0, _c(2))
+    assert isinstance(res, CompositionalMetric)
+    np.testing.assert_allclose(np.asarray(res.compute()), expected)
+
+
+@pytest.mark.parametrize(
+    "op, expected",
+    [
+        (lambda m, t: m + t, [6.0, 7.0]),
+        (lambda m, t: m - t, [4.0, 3.0]),
+        (lambda m, t: m * t, [5.0, 10.0]),
+        (lambda m, t: t / m, [0.2, 0.4]),
+        (lambda m, t: t - m, [-4.0, -3.0]),
+    ],
+)
+def test_arithmetic_with_array_operand(op, expected):
+    """Metric composed with a jnp array broadcasts elementwise."""
+    res = op(_c(5), jnp.asarray([1.0, 2.0]))
+    assert isinstance(res, CompositionalMetric)
+    np.testing.assert_allclose(np.asarray(res.compute()), expected)
+
+
+class VecConst(Metric):
+    def __init__(self, vals):
+        super().__init__()
+        self.add_state("v", jnp.asarray(vals), dist_reduce_fx="sum")
+        self._update_called = True
+
+    def update(self):
+        pass
+
+    def compute(self):
+        return self.v
+
+
+def test_matmul_two_metrics():
+    a = VecConst([1.0, 2.0, 3.0])
+    b = VecConst([4.0, 5.0, 6.0])
+    res = a @ b
+    assert isinstance(res, CompositionalMetric)
+    np.testing.assert_allclose(np.asarray(res.compute()), 32.0)
+
+
+def test_reflected_bitwise():
+    t = jnp.asarray([True, False])
+    iv = VecConst([1, 0])
+    np.testing.assert_array_equal(np.asarray((t & iv).compute()), [True, False])
+    np.testing.assert_array_equal(np.asarray((t | iv).compute()), [True, False])
+    np.testing.assert_array_equal(np.asarray((t ^ iv).compute()), [False, False])
+
+
+def test_pos_is_abs_reference_quirk():
+    """reference metric.py: __pos__ maps to abs(), not identity — kept for
+    parity (documented quirk)."""
+    res = +_c(-3)
+    np.testing.assert_allclose(np.asarray(res.compute()), 3.0)
+
+
+def test_composition_pickles_and_repr():
+    """Composed metrics must pickle (reference parity: tests/bases/
+    test_metric.py pickling) — including unary ops and __getitem__, whose
+    operator must not be a lambda or an unpicklable jnp ufunc wrapper."""
+    import pickle
+
+    res = _c(5) + _c(2)
+    clone = pickle.loads(pickle.dumps(res))
+    np.testing.assert_allclose(np.asarray(clone.compute()), 7.0)
+    assert "CompositionalMetric" in repr(res)
+    for expr, want in ((abs(-1.0 * _c(3)), 3.0), (-_c(4), -4.0),
+                       (VecConst([1.0, 9.0])[1], 9.0), (2.0 ** _c(3), 8.0)):
+        got = pickle.loads(pickle.dumps(expr)).compute()
+        np.testing.assert_allclose(np.asarray(got), want)
+
+
+def test_tuple_returning_compute_composition_is_loud():
+    """Composing metrics whose compute() returns a tuple must raise like the
+    jnp ufuncs do — not silently concatenate the tuples (operator.add would)."""
+    class TupleMetric(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("v", jnp.asarray(1.0), dist_reduce_fx="sum")
+            self._update_called = True
+
+        def update(self):
+            pass
+
+        def compute(self):
+            return (self.v, self.v * 2)
+
+    combo = TupleMetric() + TupleMetric()
+    with pytest.raises(TypeError):
+        combo.compute()
