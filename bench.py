@@ -31,7 +31,7 @@ NUM_CLASSES = 10
 SCAN_STEPS = 200
 
 
-def _ensure_backend(probe_timeout: int = 240, attempts: int = 2) -> str:
+def _ensure_backend(probe_timeout: int = 150, attempts: int = 2) -> str:
     """Make sure jax can actually initialize a backend before benching.
 
     The ambient accelerator plugin (JAX_PLATFORMS=axon tunnel) can fail or

@@ -3,7 +3,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from metrics_tpu import Accuracy, AverageMeter, BootStrapper, MetricTracker
+from metrics_tpu import Accuracy, AverageMeter, BootStrapper, MeanSquaredError, MetricTracker
 from tests.helpers.testers import DummyMetricSum
 
 
@@ -75,3 +75,81 @@ def test_tracker_minimize():
         tracker.increment()
         tracker.update(jnp.asarray(v))
     assert tracker.best_metric() == 1.0
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+def test_bootstrap_sampler_resamples_with_replacement(sampling_strategy):
+    """Analogue of reference ``test_bootstrapping.py::test_bootstrap_sampler``:
+    sampled indices draw only from the original rows, some row repeats, and
+    some row is left out (sampling WITH replacement)."""
+    import jax
+
+    from metrics_tpu.wrappers.bootstrapping import _bootstrap_sampler
+
+    key = jax.random.PRNGKey(7)
+    idx = np.asarray(_bootstrap_sampler(key, 20, sampling_strategy=sampling_strategy))
+    assert idx.min() >= 0 and idx.max() < 20
+    counts = np.bincount(idx, minlength=20)
+    assert counts.max() >= 2, "no row sampled twice — not with-replacement"
+    assert (counts == 0).any(), "every row sampled — not a bootstrap draw"
+
+
+@pytest.mark.parametrize(
+    "metric_ctor, data",
+    [
+        (lambda: Accuracy(num_classes=4), "cls"),
+        (lambda: MeanSquaredError(), "reg"),
+    ],
+)
+def test_bootstrap_mean_tracks_full_data_value(metric_ctor, data):
+    """Reference ``test_bootstrap``: the bootstrapped mean sits near the
+    full-data metric value, and std is small but nonzero."""
+    rng = np.random.RandomState(42)
+    if data == "cls":
+        a = jnp.asarray(rng.randint(0, 4, (400,)))
+        b = jnp.asarray(rng.randint(0, 4, (400,)))
+    else:
+        a = jnp.asarray(rng.randn(400).astype(np.float32))
+        b = jnp.asarray(rng.randn(400).astype(np.float32))
+    base = metric_ctor()
+    base.update(a, b)
+    full = float(base.compute())
+
+    boot = BootStrapper(metric_ctor(), num_bootstraps=50, seed=3)
+    boot.update(a, b)
+    out = boot.compute()
+    assert abs(float(out["mean"]) - full) < 0.15 * max(abs(full), 0.1)
+    assert 0 < float(out["std"]) < max(abs(full), 0.5)
+
+
+def test_tracker_wrong_input_raises():
+    with pytest.raises(TypeError, match="instance of a metrics_tpu metric"):
+        MetricTracker([1, 2, 3])
+
+
+@pytest.mark.parametrize(
+    "method, args",
+    [("update", (jnp.asarray(1.0),)), ("forward", (jnp.asarray(1.0),)), ("compute", ())],
+)
+def test_tracker_all_methods_require_increment(method, args):
+    tracker = MetricTracker(DummyMetricSum())
+    with pytest.raises(ValueError, match=f"`{method}` cannot be called before"):
+        getattr(tracker, method)(*args)
+
+
+def test_tracker_update_and_forward_interleaved():
+    """Reference ``test_tracker``: both update() and forward() accumulate into
+    the current step's clone."""
+    tracker = MetricTracker(MeanSquaredError(), maximize=False)
+    rng = np.random.RandomState(1)
+    for i in range(3):
+        tracker.increment()
+        for _ in range(2):
+            tracker.update(jnp.asarray(rng.randn(20)), jnp.asarray(rng.randn(20)))
+        for _ in range(2):
+            tracker(jnp.asarray(rng.randn(20)), jnp.asarray(rng.randn(20)))
+        assert float(tracker.compute()) > 0
+        assert tracker.n_steps == i + 1
+    assert np.asarray(tracker.compute_all()).shape[0] == 3
+    best, idx = tracker.best_metric(return_step=True)
+    assert best > 0 and idx in range(3)
