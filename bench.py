@@ -3,9 +3,9 @@
 Default (driver contract): runs BASELINE config 1 and prints ONE JSON line
 ``{"metric", "value", "unit", "vs_baseline"}``.
 
-``python bench.py --all`` additionally runs BASELINE configs 2-6 (one JSON
-line each; see BASELINE.md for the config table and BENCH.md for recorded
-numbers).
+``python bench.py --all`` additionally runs BASELINE configs 2-7 (one JSON
+line each; ``--config N`` runs a single one; see BASELINE.md for the config
+table and BENCH.md for recorded numbers).
 
 Timing methodology (see BENCH.md): hot paths are timed **on-chip** by
 scanning K steps inside ONE jitted program (``lax.scan``) and dividing — a
@@ -31,7 +31,7 @@ NUM_CLASSES = 10
 SCAN_STEPS = 200
 
 
-def _ensure_backend(probe_timeout: int = 150, attempts: int = 2) -> str:
+def _ensure_backend(probe_timeouts=(240, 60)) -> str:
     """Make sure jax can actually initialize a backend before benching.
 
     The ambient accelerator plugin (JAX_PLATFORMS=axon tunnel) can fail or
@@ -47,13 +47,17 @@ def _ensure_backend(probe_timeout: int = 150, attempts: int = 2) -> str:
     if plats == "cpu":
         import jax
 
+        # the env var alone is ineffective when jax was PRELOADED before this
+        # process's env took effect (site preload) — pin via config too, or
+        # jax.devices() would still initialize the ambient accelerator plugin
+        jax.config.update("jax_platforms", "cpu")
         return jax.devices()[0].platform
     # empty JAX_PLATFORMS still auto-detects accelerator plugins, so it gets
     # the same timeout-guarded probe as an explicit accelerator setting
 
     code = "import jax; d = jax.devices(); print('PROBE_OK', d[0].platform)"
     last_err = None
-    for _ in range(attempts):
+    for probe_timeout in probe_timeouts:
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
@@ -401,6 +405,88 @@ def bench_config5() -> None:
     _emit("retrieval_map_ndcg_compute", round(per_call * 1e3, 2), "ms/65536-docs", vs)
 
 
+def bench_config7() -> None:
+    """North star (BASELINE.md): metric overhead < 1% of forward-pass time in
+    an eval loop running FID + Accuracy + AUROC together.
+
+    Measures the SAME eval loop twice by slope — model forward only vs
+    model forward + all three metric updates fused into the step — and
+    reports the overhead ratio."""
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import AUROC, Accuracy, FID, MetricCollection
+
+    on_tpu = jax.default_backend() == "tpu"
+    batch = 16 if on_tpu else 4
+    img_px = 299 if on_tpu else 96  # CPU: keep the conv stack affordable
+    rng = np.random.RandomState(0)
+    imgs = jnp.asarray(rng.rand(batch, 3, img_px, img_px).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 10, (batch,)))
+
+    # separate instances: `inception` is the MODEL under evaluation; the FID
+    # metric receives its precomputed features (feature=identity), so the
+    # overhead number attributes ONLY the moment update to the metric — not
+    # a second forward that would otherwise hide inside FID.update unless
+    # XLA happened to CSE it
+    inception = FID(feature=2048, streaming=True).inception
+    fid = FID(feature=lambda f: f, feature_dim=2048, streaming=True)
+    head = jnp.asarray(rng.rand(2048, 10).astype(np.float32) * 0.01)
+
+    mc = MetricCollection({"acc": Accuracy(num_classes=10)})
+    auroc = AUROC().with_capacity(64 * batch)
+    probs_w = jax.nn.softmax(rng.rand(batch, 10).astype(np.float32))
+    mc.update(jnp.asarray(probs_w), labels)
+    mc.reset()
+    auroc.update(jnp.asarray(probs_w[:, 1]), (labels == 1).astype(jnp.int32))
+    auroc.reset()
+
+    def _step_inputs(chk):
+        # carry-dependent epsilon: numerically nil but makes the forward
+        # iteration-dependent, so XLA cannot hoist it out of the scan in
+        # EITHER program (hoisting only one corrupts the comparison)
+        return imgs + chk * 1e-24
+
+    def fwd_only(state):
+        chk, fid_s, rest = state
+        feats = inception(_step_inputs(chk))
+        logits = feats @ head
+        return (chk + logits.sum() * 1e-12, fid_s, rest)
+
+    def fwd_with_metrics(state):
+        chk, fid_s, (mc_s, au_s) = state
+        x = _step_inputs(chk)
+        feats = inception(x)
+        logits = feats @ head
+        probs = jax.nn.softmax(logits, -1)
+        fid_s = fid.pure_update(fid_s, feats, True)
+        mc_s = mc.pure_update(mc_s, probs, labels)
+        au_s = auroc.pure_update(au_s, probs[:, 1], (labels == 1).astype(jnp.int32))
+        return (chk + logits.sum() * 1e-12, fid_s, (mc_s, au_s))
+
+    feats0 = inception(imgs)
+    fid_s0 = fid.pure_update(fid.init_state(), feats0, True)
+    au_s0 = auroc.pure_update(auroc.init_state(), jnp.asarray(probs_w[:, 1]), (labels == 1).astype(jnp.int32))
+    state0 = (jnp.zeros(()), fid_s0, (mc.init_state(), au_s0))
+
+    k1, k2 = (4, 20) if on_tpu else (2, 6)
+    base_s, c1, r1, _ = _time_scan_step(fwd_only, state0, k1=k1, k2=k2)
+    full_s, c2, r2, _ = _time_scan_step(fwd_with_metrics, state0, k1=k1, k2=k2)
+    base_s = max(base_s, r1)
+    full_s = max(full_s, r2)
+    overhead_pct = max(full_s - base_s, 0.0) / base_s * 100.0
+    _diag(config=7, fwd_ms=round(base_s * 1e3, 2), with_metrics_ms=round(full_s * 1e3, 2),
+          overhead_pct=round(overhead_pct, 2), compile_s=round(c1 + c2, 1))
+    if not on_tpu:
+        # the target is defined against an ACCELERATOR forward pass
+        # (BASELINE.md: v4-class eval loop); on the scaled-down CPU stand-in
+        # the fixed 2048^2 FID moment update dwarfs the tiny forward, so the
+        # ratio would misrepresent the design. Record diagnostics only.
+        _diag(config=7, note="overhead ratio only meaningful vs an accelerator forward; skipped on cpu")
+        return
+    _emit("metric_overhead_vs_forward", round(overhead_pct, 2), "percent")
+
+
 def bench_config6() -> None:
     """Config 6: pallas binned PR-curve kernel vs fused-XLA path on hardware
     (VERDICT round-1: the claimed pallas speedup was never captured in a
@@ -464,9 +550,15 @@ def main() -> None:
     except Exception:
         vs = None
     _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
-    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6}
+    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7}
     if "--config" in sys.argv:
-        wanted = [extra[sys.argv[sys.argv.index("--config") + 1]]]
+        i = sys.argv.index("--config") + 1
+        key = sys.argv[i] if i < len(sys.argv) else None
+        if key not in extra:
+            print(json.dumps({"diagnostic": f"--config takes one of {sorted(extra)} (config 1 always runs); got {key!r}"}), file=sys.stderr)
+            wanted = []
+        else:
+            wanted = [extra[key]]
     elif "--all" in sys.argv:
         wanted = list(extra.values())
     else:
