@@ -131,6 +131,15 @@ def pit_permutate(preds: Array, perm: Array) -> Array:
 
     Returns:
         permuted estimates, same shape as ``preds``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pit, pit_permutate, si_sdr
+        >>> preds = jnp.asarray([[[0.0, 1.0, 2.0], [3.0, 4.0, 5.0]]])
+        >>> target = jnp.asarray([[[3.1, 3.9, 5.2], [0.2, 0.9, 2.1]]])
+        >>> best_metric, best_perm = pit(preds, target, si_sdr, eval_func="max")
+        >>> print(pit_permutate(preds, best_perm)[0, 0])
+        [3. 4. 5.]
     """
     return jnp.take_along_axis(
         preds, perm.reshape(perm.shape + (1,) * (preds.ndim - 2)), axis=1

@@ -161,6 +161,14 @@ def accuracy(
     Contract identical to the reference's functional ``accuracy``
     (``functional/classification/accuracy.py:256-418``); accepts all input
     types, supports top-k and subset accuracy.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import accuracy
+        >>> target = jnp.asarray([0, 1, 2, 3])
+        >>> preds = jnp.asarray([0, 2, 1, 3])
+        >>> print(round(float(accuracy(preds, target)), 4))
+        0.5
     """
     allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
     if average not in allowed_average:

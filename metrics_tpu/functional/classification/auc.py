@@ -47,6 +47,15 @@ def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
 
 
 def auc(x: Array, y: Array, reorder: bool = False) -> Array:
-    """Trapezoidal area under the (x, y) curve."""
+    """Trapezoidal area under the (x, y) curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import auc
+        >>> x = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> y = jnp.asarray([0.0, 1.0, 2.0, 2.0])
+        >>> print(round(float(auc(x, y)), 4))
+        4.0
+    """
     x, y = _auc_update(x, y)
     return _auc_compute(x, y, reorder=reorder)

@@ -132,6 +132,15 @@ def auroc(
     max_fpr: Optional[float] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Array:
-    """Area under the ROC curve (binary / multiclass / multilabel)."""
+    """Area under the ROC curve (binary / multiclass / multilabel).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import auroc
+        >>> preds = jnp.asarray([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> print(round(float(auroc(preds, target, pos_label=1)), 4))
+        0.5
+    """
     preds, target, mode = _auroc_update(preds, target)
     return _auroc_compute(preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights)

@@ -93,7 +93,16 @@ def average_precision(
     average: Optional[str] = "macro",
     sample_weights: Optional[Sequence] = None,
 ) -> Union[List[Array], Array]:
-    """Average precision score (area under the PR step curve)."""
+    """Average precision score (area under the PR step curve).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import average_precision
+        >>> pred = jnp.asarray([0.1, 0.2, 0.3, 0.4])
+        >>> target = jnp.asarray([0, 0, 1, 1])
+        >>> print(round(float(average_precision(pred, target, pos_label=1)), 4))
+        1.0
+    """
     preds, target, num_classes, pos_label = _average_precision_update(
         preds, target, num_classes, pos_label, average
     )

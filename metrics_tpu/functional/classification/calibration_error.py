@@ -76,7 +76,16 @@ def _ce_update(preds: Array, target: Array) -> Tuple[Array, Array]:
 
 
 def calibration_error(preds: Array, target: Array, n_bins: int = 15, norm: str = "l1") -> Array:
-    r"""Top-label calibration error (L1 = ECE, L2 = RMSCE, max = MCE)."""
+    r"""Top-label calibration error (L1 = ECE, L2 = RMSCE, max = MCE).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import calibration_error
+        >>> preds = jnp.asarray([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.asarray([0, 0, 1, 1, 1])
+        >>> print(round(float(calibration_error(preds, target, n_bins=2, norm="l1")), 4))
+        0.29
+    """
     if norm not in ("l1", "l2", "max"):
         raise ValueError(f"Norm {norm} is not supported. Please select from l1, l2, or max. ")
     if not isinstance(n_bins, int) or n_bins <= 0:

@@ -45,6 +45,15 @@ def cohen_kappa(
     weights: Optional[str] = None,
     threshold: float = 0.5,
 ) -> Array:
-    r"""Cohen's kappa inter-annotator agreement score."""
+    r"""Cohen's kappa inter-annotator agreement score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cohen_kappa
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> print(round(float(cohen_kappa(preds, target, num_classes=2)), 4))
+        0.5
+    """
     confmat = _cohen_kappa_update(preds, target, num_classes, threshold)
     return _cohen_kappa_compute(confmat, weights)

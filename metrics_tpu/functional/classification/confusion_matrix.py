@@ -73,6 +73,16 @@ def confusion_matrix(
     threshold: float = 0.5,
     multilabel: bool = False,
 ) -> Array:
-    """[C, C] confusion matrix (or [C, 2, 2] per-label matrices if multilabel)."""
+    """[C, C] confusion matrix (or [C, 2, 2] per-label matrices if multilabel).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import confusion_matrix
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> print(confusion_matrix(preds, target, num_classes=2))
+        [[2 0]
+         [1 1]]
+    """
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel)
     return _confusion_matrix_compute(confmat, normalize)

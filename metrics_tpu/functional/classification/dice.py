@@ -21,7 +21,16 @@ def dice_score(
     no_fg_score: float = 0.0,
     reduction: str = "elementwise_mean",
 ) -> Array:
-    """Dice = 2·TP / (2·TP + FP + FN) per class, reduced over classes."""
+    """Dice = 2·TP / (2·TP + FP + FN) per class, reduced over classes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import dice_score
+        >>> pred = jnp.asarray([[0.85, 0.05, 0.05, 0.05], [0.05, 0.85, 0.05, 0.05]])
+        >>> target = jnp.asarray([0, 1])
+        >>> print(round(float(dice_score(pred, target)), 4))
+        0.3333
+    """
     num_classes = preds.shape[1]
     start = 0 if bg else 1
 

@@ -94,7 +94,16 @@ def fbeta(
     multiclass: Optional[bool] = None,
 ) -> Array:
     r"""F-beta :math:`(1+\beta^2)\frac{P \cdot R}{\beta^2 P + R}`
-    (reference ``f_beta.py:111-215``)."""
+    (reference ``f_beta.py:111-215``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import fbeta
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> print(round(float(fbeta(preds, target, num_classes=3, beta=0.5)), 4))
+        0.3333
+    """
     allowed_average = list(AvgMethod)
     if average not in allowed_average:
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
@@ -123,7 +132,16 @@ def f1(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """F1 = F-beta with beta=1 (reference ``f_beta.py:218-320``)."""
+    """F1 = F-beta with beta=1 (reference ``f_beta.py:218-320``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import f1
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> print(round(float(f1(preds, target, num_classes=3)), 4))
+        0.3333
+    """
     return fbeta(
         preds, target, 1.0, average, mdmc_average, ignore_index, num_classes,
         threshold, top_k, multiclass,

@@ -25,6 +25,15 @@ def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array
 
 
 def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
-    r"""Average Hamming loss: fraction of labels predicted incorrectly."""
+    r"""Average Hamming loss: fraction of labels predicted incorrectly.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hamming_distance
+        >>> target = jnp.asarray([[0, 1], [1, 1]])
+        >>> preds = jnp.asarray([[0, 1], [0, 1]])
+        >>> print(round(float(hamming_distance(preds, target)), 4))
+        0.25
+    """
     correct, total = _hamming_distance_update(preds, target, threshold)
     return _hamming_distance_compute(correct, total)

@@ -89,6 +89,15 @@ def hinge(
     squared: bool = False,
     multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
 ) -> Array:
-    r"""Mean hinge loss :math:`\max(0, 1 - margin)`, typically for SVMs."""
+    r"""Mean hinge loss :math:`\max(0, 1 - margin)`, typically for SVMs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import hinge
+        >>> target = jnp.asarray([0, 1, 1])
+        >>> preds = jnp.asarray([-2.2, 2.4, 0.1])
+        >>> print(round(float(hinge(preds, target)), 4))
+        0.3
+    """
     measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
     return _hinge_compute(measure, total)

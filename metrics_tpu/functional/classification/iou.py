@@ -44,7 +44,16 @@ def iou(
     num_classes: Optional[int] = None,
     reduction: str = "elementwise_mean",
 ) -> Array:
-    r"""Jaccard index :math:`J(A,B) = \frac{|A\cap B|}{|A\cup B|}`."""
+    r"""Jaccard index :math:`J(A,B) = \frac{|A\cap B|}{|A\cup B|}`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import iou
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> print(round(float(iou(preds, target)), 4))
+        0.5833
+    """
     num_classes = get_num_classes(preds=preds, target=target, num_classes=num_classes)
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
     return _iou_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

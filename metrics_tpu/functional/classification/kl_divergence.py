@@ -38,14 +38,32 @@ def _kld_compute(measures: Array, total: Array, reduction: Optional[str] = "mean
 
 
 def kl_divergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
-    r"""KL divergence :math:`D_{KL}(P||Q) = \sum_x P(x)\log\frac{P(x)}{Q(x)}`."""
+    r"""KL divergence :math:`D_{KL}(P||Q) = \sum_x P(x)\log\frac{P(x)}{Q(x)}`.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import kl_divergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> print(round(float(kl_divergence(p, q)), 4))
+        0.0853
+    """
     measures, total = _kld_update(p, q, log_prob)
     return _kld_compute(measures, jnp.asarray(total), reduction)
 
 
 def kldivergence(p: Array, q: Array, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
     """Deprecated alias of :func:`kl_divergence` (reference
-    ``torchmetrics/functional/classification/kl_divergence.py:114-147``)."""
+    ``torchmetrics/functional/classification/kl_divergence.py:114-147``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import kldivergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> print(round(float(kldivergence(p, q)), 4))
+        0.0853
+    """
     from warnings import warn
 
     warn(

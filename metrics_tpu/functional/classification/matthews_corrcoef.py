@@ -27,6 +27,15 @@ def matthews_corrcoef(
     num_classes: int,
     threshold: float = 0.5,
 ) -> Array:
-    r"""MCC — general correlation quality of a classification."""
+    r"""MCC — general correlation quality of a classification.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import matthews_corrcoef
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> print(round(float(matthews_corrcoef(preds, target, num_classes=2)), 4))
+        0.5774
+    """
     confmat = _matthews_corrcoef_update(preds, target, num_classes, threshold)
     return _matthews_corrcoef_compute(confmat)

@@ -90,7 +90,16 @@ def precision(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    r"""Precision :math:`\frac{TP}{TP + FP}` (reference ``precision_recall.py:76``)."""
+    r"""Precision :math:`\frac{TP}{TP + FP}` (reference ``precision_recall.py:76``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> print(round(float(precision(preds, target, average="macro", num_classes=3)), 4))
+        0.2222
+    """
     _check_prf_args(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ["weighted", "none", None] else average
     tp, fp, tn, fn = _stat_scores_update(
@@ -111,7 +120,16 @@ def recall(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    r"""Recall :math:`\frac{TP}{TP + FN}` (reference ``precision_recall.py:214``)."""
+    r"""Recall :math:`\frac{TP}{TP + FN}` (reference ``precision_recall.py:214``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import recall
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> print(round(float(recall(preds, target, average="macro", num_classes=3)), 4))
+        0.3333
+    """
     _check_prf_args(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ["weighted", "none", None] else average
     tp, fp, tn, fn = _stat_scores_update(
@@ -132,7 +150,17 @@ def precision_recall(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Tuple[Array, Array]:
-    """Both precision and recall from one stat-scores pass (reference ``precision_recall.py:352``)."""
+    """Both precision and recall from one stat-scores pass (reference ``precision_recall.py:352``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall
+        >>> target = jnp.asarray([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.asarray([0, 2, 1, 0, 0, 1])
+        >>> p, r = precision_recall(preds, target, average="macro", num_classes=3)
+        >>> print(round(float(p), 4), round(float(r), 4))
+        0.2222 0.3333
+    """
     _check_prf_args(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ["weighted", "none", None] else average
     tp, fp, tn, fn = _stat_scores_update(

@@ -149,6 +149,16 @@ def precision_recall_curve(
     pos_label: Optional[int] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-    """Precision-recall pairs for all distinct thresholds (eager, exact)."""
+    """Precision-recall pairs for all distinct thresholds (eager, exact).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import precision_recall_curve
+        >>> pred = jnp.asarray([0.0, 1.0, 2.0, 3.0])
+        >>> target = jnp.asarray([0, 1, 1, 0])
+        >>> precisions, recalls, thresholds = precision_recall_curve(pred, target, pos_label=1)
+        >>> print(precisions)
+        [0.6666667 0.5       0.        1.       ]
+    """
     preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
     return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -44,7 +44,16 @@ def specificity(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    r"""Specificity :math:`\frac{TN}{TN + FP}` (reference ``specificity.py:70-215``)."""
+    r"""Specificity :math:`\frac{TN}{TN + FP}` (reference ``specificity.py:70-215``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import specificity
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> print(round(float(specificity(preds, target, average="micro")), 4))
+        0.75
+    """
     allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
     if average not in allowed_average:
         raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")

@@ -163,6 +163,14 @@ def stat_scores(
     Public functional entry point; contract identical to the reference's
     ``stat_scores`` (``functional/classification/stat_scores.py:240-397``):
     returns a ``(..., 5)`` array of ``[tp, fp, tn, fn, support]``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import stat_scores
+        >>> target = jnp.asarray([1, 1, 0, 0])
+        >>> preds = jnp.asarray([0, 1, 0, 0])
+        >>> print(stat_scores(preds, target, reduce="micro"))
+        [3 1 3 1 4]
     """
     if reduce not in ["micro", "macro", "samples"]:
         raise ValueError(f"The `reduce` {reduce} is not valid.")

@@ -23,6 +23,15 @@ def _compute_image_gradients(img: Array) -> Tuple[Array, Array]:
 
 def image_gradients(img: Array) -> Tuple[Array, Array]:
     """Per-pixel (dy, dx) gradients of a BxCxHxW image batch
-    (reference ``gradients.py:60-82``)."""
+    (reference ``gradients.py:60-82``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import image_gradients
+        >>> img = jnp.arange(16.0).reshape(1, 1, 4, 4)
+        >>> dy, dx = image_gradients(img)
+        >>> print(dy[0, 0, 0])
+        [4. 4. 4. 4.]
+    """
     _image_gradients_validate(img)
     return _compute_image_gradients(img)

@@ -70,6 +70,14 @@ def psnr(
         base: logarithm base.
         reduction: 'elementwise_mean' | 'sum' | 'none' over per-slice scores.
         dim: dimensions to reduce over; ``None`` = all.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import psnr
+        >>> pred = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        >>> print(round(float(psnr(pred, target)), 4))
+        2.5527
     """
     if dim is None and reduction != "elementwise_mean":
         rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")

@@ -140,6 +140,14 @@ def ssim(
     k1: float = 0.01,
     k2: float = 0.03,
 ) -> Array:
-    """Structural Similarity Index Measure (reference ``ssim.py:181-226``)."""
+    """Structural Similarity Index Measure (reference ``ssim.py:181-226``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import ssim
+        >>> img = jnp.arange(256.0).reshape(1, 1, 16, 16) / 255.0
+        >>> print(round(float(ssim(img, img * 0.9 + 0.05)), 4))
+        0.9945
+    """
     preds, target = _ssim_update(preds, target)
     return _ssim_compute(preds, target, kernel_size, sigma, reduction, data_range, k1, k2)

@@ -14,7 +14,15 @@ def bleu_score(
     n_gram: int = 4,
     smooth: bool = False,
 ) -> Array:
-    """Deprecated — use :func:`metrics_tpu.functional.text.bleu.bleu_score`."""
+    """Deprecated — use :func:`metrics_tpu.functional.text.bleu.bleu_score`.
+
+    Example:
+        >>> from metrics_tpu.functional.nlp import bleu_score
+        >>> translate_corpus = ["the cat is on the mat".split()]
+        >>> reference_corpus = [["there is a cat on the mat".split(), "a cat is on the mat".split()]]
+        >>> print(round(float(bleu_score(reference_corpus, translate_corpus)), 4))
+        0.7598
+    """
     warn(
         "Function `functional.nlp.bleu_score` is deprecated. "
         "Use `functional.text.bleu.bleu_score` instead.",

@@ -28,6 +28,15 @@ def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[
 
 
 def cosine_similarity(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
-    r"""Cosine similarity between rows of preds and target."""
+    r"""Cosine similarity between rows of preds and target.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import cosine_similarity
+        >>> preds = jnp.asarray([[3.0, 4.0], [1.0, 0.0]])
+        >>> target = jnp.asarray([[6.0, 8.0], [0.0, 1.0]])
+        >>> print(cosine_similarity(preds, target, reduction=None))
+        [1. 0.]
+    """
     preds, target = _cosine_similarity_update(preds, target)
     return _cosine_similarity_compute(preds, target, reduction)

@@ -59,7 +59,16 @@ def explained_variance(
     target: Array,
     multioutput: str = "uniform_average",
 ) -> Union[Array, Sequence[Array]]:
-    """Explained variance score."""
+    """Explained variance score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import explained_variance
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> print(round(float(explained_variance(preds, target)), 4))
+        0.9572
+    """
     n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
     return _explained_variance_compute(
         n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target, multioutput

@@ -18,6 +18,13 @@ def _mean_absolute_error_compute(sum_abs_error: Array, n_obs) -> Array:
 
 
 def mean_absolute_error(preds: Array, target: Array) -> Array:
-    """Mean absolute error."""
+    """Mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_error
+        >>> print(round(float(mean_absolute_error(jnp.asarray([0.0, 1.0, 2.0]), jnp.asarray([0.5, 1.0, 2.5]))), 4))
+        0.3333
+    """
     sum_abs_error, n_obs = _mean_absolute_error_update(preds, target)
     return _mean_absolute_error_compute(sum_abs_error, n_obs)

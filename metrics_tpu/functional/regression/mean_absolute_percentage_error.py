@@ -21,6 +21,13 @@ def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs) -
 
 
 def mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """Mean absolute percentage error."""
+    """Mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_absolute_percentage_error
+        >>> print(round(float(mean_absolute_percentage_error(jnp.asarray([9.0, 19.0]), jnp.asarray([10.0, 20.0]))), 4))
+        0.075
+    """
     sum_abs_per_error, num_obs = _mean_absolute_percentage_error_update(preds, target)
     return _mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

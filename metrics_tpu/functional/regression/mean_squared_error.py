@@ -20,6 +20,13 @@ def _mean_squared_error_compute(sum_squared_error: Array, n_obs, squared: bool =
 
 
 def mean_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
-    """Mean squared error (RMSE with ``squared=False``)."""
+    """Mean squared error (RMSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_error
+        >>> print(round(float(mean_squared_error(jnp.asarray([0.0, 1.0]), jnp.asarray([1.0, 1.0]))), 4))
+        0.5
+    """
     sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
     return _mean_squared_error_compute(sum_squared_error, n_obs, squared)

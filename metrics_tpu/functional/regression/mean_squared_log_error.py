@@ -18,6 +18,13 @@ def _mean_squared_log_error_compute(sum_squared_log_error: Array, n_obs) -> Arra
 
 
 def mean_squared_log_error(preds: Array, target: Array) -> Array:
-    """Mean squared log error."""
+    """Mean squared log error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import mean_squared_log_error
+        >>> print(round(float(mean_squared_log_error(jnp.asarray([0.5, 1.0, 2.0]), jnp.asarray([0.5, 2.0, 2.0]))), 4))
+        0.0548
+    """
     sum_squared_log_error, n_obs = _mean_squared_log_error_update(preds, target)
     return _mean_squared_log_error_compute(sum_squared_log_error, n_obs)

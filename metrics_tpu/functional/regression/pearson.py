@@ -44,7 +44,14 @@ def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Ar
 
 
 def pearson_corrcoef(preds: Array, target: Array) -> Array:
-    """Pearson correlation coefficient."""
+    """Pearson correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import pearson_corrcoef
+        >>> print(round(float(pearson_corrcoef(jnp.asarray([1.0, 2.0, 3.0, 4.0]), jnp.asarray([2.0, 4.0, 6.0, 9.0]))), 4))
+        0.9944
+    """
     zero = jnp.zeros((), dtype=preds.dtype if jnp.issubdtype(preds.dtype, jnp.floating) else jnp.float32)
     _, _, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
         preds, target, zero, zero, zero, zero, zero, zero

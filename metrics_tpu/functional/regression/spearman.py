@@ -50,6 +50,13 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -
 
 
 def spearman_corrcoef(preds: Array, target: Array) -> Array:
-    """Spearman rank correlation coefficient."""
+    """Spearman rank correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import spearman_corrcoef
+        >>> print(round(float(spearman_corrcoef(jnp.asarray([1.0, 2.0, 3.0, 4.0]), jnp.asarray([1.0, 3.0, 2.0, 4.0]))), 4))
+        0.8
+    """
     preds, target = _spearman_corrcoef_update(preds, target)
     return _spearman_corrcoef_compute(preds, target)

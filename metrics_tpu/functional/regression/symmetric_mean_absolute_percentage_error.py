@@ -21,6 +21,13 @@ def _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error: Array, 
 
 
 def symmetric_mean_absolute_percentage_error(preds: Array, target: Array) -> Array:
-    """Symmetric mean absolute percentage error."""
+    """Symmetric mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import symmetric_mean_absolute_percentage_error
+        >>> print(round(float(symmetric_mean_absolute_percentage_error(jnp.asarray([9.0, 19.0]), jnp.asarray([10.0, 20.0]))), 4))
+        0.0783
+    """
     sum_abs_per_error, num_obs = _symmetric_mean_absolute_percentage_error_update(preds, target)
     return _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error, num_obs)

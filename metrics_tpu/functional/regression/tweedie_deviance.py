@@ -52,6 +52,13 @@ def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations:
 
 
 def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
-    r"""Tweedie deviance: Gaussian (0), Poisson (1), Gamma (2) or compound."""
+    r"""Tweedie deviance: Gaussian (0), Poisson (1), Gamma (2) or compound.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import tweedie_deviance_score
+        >>> print(round(float(tweedie_deviance_score(jnp.asarray([2.0, 0.5]), jnp.asarray([1.0, 1.0]), power=0.0)), 4))
+        0.625
+    """
     sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, power)
     return _tweedie_deviance_score_compute(sum_deviance_score, num_observations)

@@ -7,7 +7,16 @@ from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 
 
 def retrieval_average_precision(preds: Array, target: Array) -> Array:
-    """AP of one query's predictions; 0 if no positive target."""
+    """AP of one query's predictions; 0 if no positive target.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_average_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> print(round(float(retrieval_average_precision(preds, target)), 4))
+        0.8333
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not jnp.sum(target):
         return jnp.asarray(0.0)

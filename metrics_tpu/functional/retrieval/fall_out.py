@@ -9,7 +9,16 @@ from metrics_tpu.utils.checks import _check_retrieval_k, _check_retrieval_functi
 
 
 def retrieval_fall_out(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """Fraction of non-relevant documents among the top ``k`` retrieved."""
+    """Fraction of non-relevant documents among the top ``k`` retrieved.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_fall_out
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> print(round(float(retrieval_fall_out(preds, target, k=2)), 4))
+        1.0
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if k is None:
         k = preds.shape[-1]

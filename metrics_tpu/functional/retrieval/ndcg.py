@@ -14,7 +14,16 @@ def _dcg(target: Array) -> Array:
 
 
 def retrieval_normalized_dcg(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """nDCG with linear gain (reference semantics); non-binary targets allowed."""
+    """nDCG with linear gain (reference semantics); non-binary targets allowed.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_normalized_dcg
+        >>> preds = jnp.asarray([0.1, 0.2, 0.3, 4.0, 70.0])
+        >>> target = jnp.asarray([10, 0, 0, 1, 5])
+        >>> print(round(float(retrieval_normalized_dcg(preds, target)), 4))
+        0.6957
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target=True)
     k = preds.shape[-1] if k is None else k
     _check_retrieval_k(k)

@@ -9,7 +9,16 @@ from metrics_tpu.utils.checks import _check_retrieval_k, _check_retrieval_functi
 
 
 def retrieval_recall(preds: Array, target: Array, k: Optional[int] = None) -> Array:
-    """Fraction of relevant documents retrieved in the top ``k``."""
+    """Fraction of relevant documents retrieved in the top ``k``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_recall
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([True, False, True])
+        >>> print(round(float(retrieval_recall(preds, target, k=2)), 4))
+        0.5
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if k is None:
         k = preds.shape[-1]

@@ -7,7 +7,16 @@ from metrics_tpu.utils.checks import _check_retrieval_functional_inputs
 
 
 def retrieval_reciprocal_rank(preds: Array, target: Array) -> Array:
-    """1 / rank of the first relevant document; 0 if none."""
+    """1 / rank of the first relevant document; 0 if none.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import retrieval_reciprocal_rank
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5])
+        >>> target = jnp.asarray([False, True, False])
+        >>> print(round(float(retrieval_reciprocal_rank(preds, target)), 4))
+        0.5
+    """
     preds, target = _check_retrieval_functional_inputs(preds, target)
     if not jnp.sum(target):
         return jnp.asarray(0.0)
