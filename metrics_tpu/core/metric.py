@@ -77,6 +77,18 @@ def _getitem(x: Any, idx: Any) -> Any:
     return x[idx]
 
 
+def _cast_floating(tree: Any, dtype: Any) -> Any:
+    """Cast every floating array leaf of a state tree to ``dtype`` (shared by
+    ``set_dtype`` and the per-update dtype persistence re-cast)."""
+
+    def cast(x):
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return apply_to_collection(tree, (jnp.ndarray, np.ndarray), cast)
+
+
 def _copy_state_value(v: Any) -> Any:
     if isinstance(v, list):
         return list(v)
@@ -628,19 +640,29 @@ class Metric:
         )
         return self
 
+    def half(self) -> "Metric":
+        """Cast floating state to float16 (reference nn.Module ``half()``)."""
+        return self.set_dtype(jnp.float16)
+
+    def float(self) -> "Metric":
+        """Cast floating state to float32 (reference nn.Module ``float()``)."""
+        return self.set_dtype(jnp.float32)
+
+    def double(self) -> "Metric":
+        """Cast floating state to float64 (reference nn.Module ``double()``).
+
+        Requires ``jax.config.update("jax_enable_x64", True)``; without it the
+        cast truncates to float32 with jax's standard warning."""
+        return self.set_dtype(jnp.float64)
+
     def set_dtype(self, dtype: Any) -> "Metric":
-        """Cast floating state leaves (analogue of reference ``metric.py:504``)."""
+        """Cast floating state leaves (analogue of reference ``metric.py:504``).
 
-        def cast(x: Array) -> Array:
-            if jnp.issubdtype(x.dtype, jnp.floating):
-                return x.astype(dtype)
-            return x
-
+        numpy leaves are cast too: materialized CatBuffer defaults are numpy
+        (tracer-safe), and missing them would revert the cast on reset."""
         self._dtype = dtype
-        # np.ndarray included: materialized CatBuffer defaults are numpy
-        # (tracer-safe), and missing them here would revert the cast on reset
-        self._restore(apply_to_collection(self._state, (jnp.ndarray, np.ndarray), cast))
-        self._defaults = apply_to_collection(self._defaults, (jnp.ndarray, np.ndarray), cast)
+        self._restore(_cast_floating(self._state, dtype))
+        self._defaults = _cast_floating(self._defaults, dtype)
         return self
 
     # pickling: jnp arrays pickle via numpy
@@ -808,6 +830,12 @@ def _wrap_update(update: Callable) -> Callable:
         self._computed = None
         self._update_called = True
         out = update(self, *args, **kwargs)
+        if self._dtype is not None:
+            # set_dtype persistence: functional `state + batch_stat` promotes
+            # back to f32, unlike torch's in-place add into a half buffer —
+            # re-cast after every update so the declared dtype sticks
+            # (identity cast when dtypes already match; XLA elides it)
+            self._restore(_cast_floating(self._state, self._dtype))
         # once an update has fixed a CatBuffer's item shape/dtype, materialize
         # the DEFAULT too (zero-filled, count 0): init_state() then returns a
         # carry with stable pytree structure, so fresh states thread straight

@@ -129,3 +129,33 @@ def test_is_differentiable_declared_everywhere_reference_does():
     assert M.Hinge.is_differentiable is True
     assert M.LPIPS.is_differentiable is True
     assert M.Metric.is_differentiable is None
+
+
+def test_half_float_double_conveniences():
+    """Reference nn.Module surface: .half()/.float()/.double() casts."""
+    import warnings
+
+    from metrics_tpu import MeanSquaredError
+
+    m = MeanSquaredError()
+    m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.5, 2.0]))
+    assert m.half() is m
+    assert m.sum_squared_error.dtype == jnp.float16
+    assert m.float() is m
+    assert m.sum_squared_error.dtype == jnp.float32
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # x64 disabled: truncation warning ok
+        m.double()
+    assert m.sum_squared_error.dtype in (jnp.float32, jnp.float64)
+
+
+def test_set_dtype_persists_through_updates():
+    """Torch parity: a half() metric stays half across subsequent updates
+    (functional adds would otherwise promote the state back to f32)."""
+    from metrics_tpu import MeanSquaredError
+
+    m = MeanSquaredError().half()
+    for _ in range(3):
+        m.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.5, 2.5]))
+    assert m.sum_squared_error.dtype == jnp.float16
+    assert float(m.compute()) == pytest.approx(0.25, rel=1e-2)
