@@ -1,0 +1,14 @@
+"""Shared example bootstrap: default to the CPU platform.
+
+Under a site-preloaded jax the ambient accelerator plugin initializes on
+first use — and hangs outright when its tunnel is down — so examples run on
+CPU unless ``--real`` is passed. Must be called before any jax backend use.
+"""
+import sys
+
+
+def pin_cpu_unless_real() -> None:
+    import jax
+
+    if "--real" not in sys.argv:
+        jax.config.update("jax_platforms", "cpu")

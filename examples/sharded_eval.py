@@ -19,12 +19,9 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))  # repo root
 
 import jax  # noqa: E402
 
-# default to the virtual CPU mesh: querying devices would INITIALIZE the
-# ambient accelerator backend first, which on a single-chip host gives a
-# 1-device mesh (and hangs outright if the remote-TPU tunnel is down).
-# pass --real to use the actual accelerator devices.
-if "--real" not in sys.argv:
-    jax.config.update("jax_platforms", "cpu")
+from _cpu_default import pin_cpu_unless_real  # noqa: E402
+
+pin_cpu_unless_real()
 
 import jax.numpy as jnp  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402

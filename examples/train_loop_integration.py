@@ -16,11 +16,9 @@ from typing import Any, Dict, Tuple
 
 import jax
 
-# default to CPU: under a site-preloaded jax the ambient accelerator plugin
-# would otherwise initialize on first use (and hang if its tunnel is down).
-# pass --real to run on the actual accelerator.
-if "--real" not in sys.argv:
-    jax.config.update("jax_platforms", "cpu")
+from _cpu_default import pin_cpu_unless_real  # noqa: E402
+
+pin_cpu_unless_real()
 
 import jax.numpy as jnp
 import numpy as np
