@@ -848,9 +848,12 @@ def _wrap_update(update: Callable) -> Callable:
                     # `live.buffer` is a tracer (first update ran inside jit),
                     # and a jnp.zeros here would bind to the ambient trace and
                     # leak a tracer into the defaults
+                    # count must be concrete too: CatBuffer's default count is
+                    # jnp.zeros(()), which under an ambient trace is a tracer
                     self._defaults[name] = CatBuffer(
                         d.capacity,
                         buffer=np.zeros(live.buffer.shape, live.buffer.dtype),
+                        count=np.zeros((), np.int32),
                     )
         return out
 

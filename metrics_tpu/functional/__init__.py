@@ -30,7 +30,8 @@ from metrics_tpu.functional.regression.mean_absolute_percentage_error import (
 from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error
 from metrics_tpu.functional.regression.mean_squared_log_error import mean_squared_log_error
 from metrics_tpu.functional.regression.pearson import pearson_corrcoef
-from metrics_tpu.functional.regression.r2 import r2_score, r2score
+from metrics_tpu.functional.regression.r2 import r2_score
+from metrics_tpu.functional.regression.r2score import r2score
 from metrics_tpu.functional.regression.spearman import spearman_corrcoef
 from metrics_tpu.functional.regression.symmetric_mean_absolute_percentage_error import (
     symmetric_mean_absolute_percentage_error,

@@ -82,27 +82,3 @@ def r2_score(
     """
     sum_squared_obs, sum_obs, rss, n_obs = _r2_score_update(preds, target)
     return _r2_score_compute(sum_squared_obs, sum_obs, rss, n_obs, adjusted, multioutput)
-
-
-def r2score(
-    preds: Array,
-    target: Array,
-    adjusted: int = 0,
-    multioutput: str = "uniform_average",
-) -> Array:
-    """Deprecated alias of :func:`r2_score` (reference
-    ``torchmetrics/functional/regression/r2score.py:22-60``).
-
-    Example:
-        >>> import jax.numpy as jnp
-        >>> from metrics_tpu.functional import r2score
-        >>> print(round(float(r2score(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4))
-        0.9486
-    """
-    from warnings import warn
-
-    warn(
-        "`functional.r2score` was renamed to `functional.r2_score` and will be removed.",
-        DeprecationWarning,
-    )
-    return r2_score(preds, target, adjusted, multioutput)

@@ -1,0 +1,36 @@
+"""Deprecated module alias for :func:`r2_score`.
+
+Parity shim mirroring the reference's
+``torchmetrics/functional/regression/r2score.py:1-48`` (deprecated in its
+v0.5: ``r2score`` renamed ``r2_score``): the shim warns and hands off to the
+real implementation. As in the reference, the package re-export rebinds the
+``regression.r2score`` attribute to this *function*, so reach the shim via
+``from metrics_tpu.functional import r2score`` (dotted module access resolves
+to the function, not this module).
+"""
+from warnings import warn
+
+from metrics_tpu.functional.regression.r2 import r2_score
+from metrics_tpu.utils.data import Array
+
+
+def r2score(
+    preds: Array,
+    target: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """Deprecated alias of :func:`r2_score` (reference
+    ``torchmetrics/functional/regression/r2score.py:22-60``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu.functional import r2score
+        >>> print(round(float(r2score(jnp.asarray([2.5, 0.0, 2.0, 8.0]), jnp.asarray([3.0, -0.5, 2.0, 7.0]))), 4))
+        0.9486
+    """
+    warn(
+        "`functional.r2score` was renamed to `functional.r2_score` and will be removed.",
+        DeprecationWarning,
+    )
+    return r2_score(preds, target, adjusted, multioutput)

@@ -177,10 +177,12 @@ class MetricLogger:
 
     def epoch_end(self) -> Dict[str, Any]:
         """Compute deferred metrics, auto-reset them, average plain values."""
+        # compute everything BEFORE any reset/clear: if a later compute()
+        # raises, no epoch state has been consumed and epoch_end can be
+        # retried without double-counting
         out: Dict[str, Any] = {}
         for name, metric in self._metrics.items():
             value = metric.compute()
-            metric.reset()
             if isinstance(value, dict):
                 for k, v in value.items():
                     out[f"{name}/{k}"] = v
@@ -192,6 +194,8 @@ class MetricLogger:
                     f"plain values logged under {name!r} collide with a computed metric entry"
                 )
             out[name] = sum(vals) / len(vals)
+        for metric in self._metrics.values():
+            metric.reset()
         self._metrics.clear()
         self._values.clear()
         self.history.append(out)

@@ -163,6 +163,38 @@ def test_metrics_reset_at_epoch_end_only():
     run_stage("test")  # trainer.test()
 
 
+def test_epoch_end_exception_leaves_state_retryable():
+    """A compute() failure mid-epoch_end must not consume any epoch state
+    (ADVICE r2: earlier metrics were reset before the raise, so a retry
+    double-counted plain values and recomputed reset metrics as empty)."""
+
+    class BoomMetric(SumMetric):
+        fail = True
+
+        def compute(self):
+            if self.fail:
+                raise RuntimeError("boom")
+            return super().compute()
+
+    logger = MetricLogger()
+    good = SumMetric()
+    good.update(jnp.asarray(4.0))
+    bad = BoomMetric()
+    bad.update(jnp.asarray(7.0))
+    logger.log("good", good)  # computed before 'boom' in dict order
+    logger.log("boom", bad)
+    logger.log("loss", 1.0)
+    with pytest.raises(RuntimeError, match="boom"):
+        logger.epoch_end()
+    # nothing was reset or cleared: the retry sees the full epoch
+    bad.fail = False
+    out = logger.epoch_end()
+    assert float(out["good"]) == 4.0
+    assert float(out["boom"]) == 7.0
+    assert out["loss"] == 1.0
+    assert float(good.x) == 0.0  # reset happened after success
+
+
 def test_logger_plain_values_and_conflicts():
     logger = MetricLogger()
     logger.log("loss", 1.0)
