@@ -166,6 +166,26 @@ def _score_from_embeddings(
     return to_layer_major(precision), to_layer_major(recall), to_layer_major(f1)
 
 
+def bundled_baseline_path(name: str = "example_en") -> str:
+    """Path to a baseline csv shipped with the package.
+
+    Only ``example_en`` ships today — a synthetic five-representation baseline
+    matching the in-repo default BERT config, for tests and as a format
+    template. Real baselines come from the official bert-score repo
+    (``rescale_baseline/<lang>/<model>.tsv``; the reference downloads them
+    over HTTP, ``functional/text/bert.py:411-449``) — fetch once on a
+    connected machine, drop the file next to your run, and point
+    ``baseline_path`` at it. See ``docs/api.md`` ("BERTScore baselines").
+    """
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))),
+                        "text", "baselines", f"{name}.csv")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no bundled baseline named {name!r} (looked at {path})")
+    return path
+
+
 def _read_baseline_csv(path: str) -> Array:
     with open(path) as handle:
         delimiter = "\t" if path.endswith(".tsv") else ","

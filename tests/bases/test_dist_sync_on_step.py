@@ -83,6 +83,187 @@ class TestDistSyncOnStepConfusionMatrix(MetricTester):
         )
 
 
+class TestDistSyncOnStepSpearman(MetricTester):
+    """Regression domain, cat-list state kind."""
+
+    atol = 1e-6
+
+    def test_spearman_cat_state_per_step_sync(self):
+        from scipy.stats import spearmanr
+
+        from metrics_tpu import SpearmanCorrcoef
+
+        preds = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+        target = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+        self.run_class_metric_test(
+            ddp=True,
+            preds=preds,
+            target=target,
+            metric_class=SpearmanCorrcoef,
+            sk_metric=lambda p, t: spearmanr(t, p).correlation,
+            dist_sync_on_step=True,
+        )
+
+
+class TestDistSyncOnStepPSNR(MetricTester):
+    """Image domain; exercises the min/max dist_reduce states (data range is
+    inferred from every rank's targets, so the per-step sync must widen it)."""
+
+    atol = 1e-4
+
+    def test_psnr_min_max_state_per_step_sync(self):
+        from metrics_tpu import PSNR
+
+        preds = (rng.rand(NUM_BATCHES, BATCH_SIZE) * 3).astype(np.float32)
+        target = (rng.rand(NUM_BATCHES, BATCH_SIZE) * 3).astype(np.float32)
+
+        def sk_psnr(p, t):
+            mse = np.mean((p.astype(np.float64) - t) ** 2)
+            # the zero-initialized min/max states participate in the running
+            # range (reference `psnr.py` does the same), so 0 is always included
+            data_range = max(t.max(), 0.0) - min(t.min(), 0.0)
+            return 10 * np.log10(data_range**2 / mse)
+
+        self.run_class_metric_test(
+            ddp=True,
+            preds=preds,
+            target=target,
+            metric_class=PSNR,
+            sk_metric=sk_psnr,
+            dist_sync_on_step=True,
+        )
+
+
+class TestDistSyncOnStepSNR(MetricTester):
+    """Audio domain, sum state kind."""
+
+    atol = 1e-4
+
+    def test_snr_per_step_sync(self):
+        from metrics_tpu import SNR
+
+        preds = rng.randn(NUM_BATCHES, BATCH_SIZE, 32).astype(np.float32)
+        target = rng.randn(NUM_BATCHES, BATCH_SIZE, 32).astype(np.float32)
+
+        def sk_snr(p, t):
+            p64, t64 = p.astype(np.float64), t.astype(np.float64)
+            snr = 10 * np.log10(
+                np.sum(t64**2, axis=-1) / np.sum((p64 - t64) ** 2, axis=-1)
+            )
+            return snr.mean()
+
+        self.run_class_metric_test(
+            ddp=True,
+            preds=preds,
+            target=target,
+            metric_class=SNR,
+            sk_metric=sk_snr,
+            dist_sync_on_step=True,
+        )
+
+
+class TestDistSyncOnStepRetrieval(MetricTester):
+    """Retrieval domain, cat-list states + an extra `indexes` update kwarg.
+
+    Indexes are a fixed per-batch pattern, so the sk reference can rebuild the
+    query assignment from the gathered group's row count alone.
+    """
+
+    atol = 1e-6
+
+    def test_retrieval_map_per_step_sync(self):
+        from metrics_tpu import RetrievalMAP
+
+        base_idx = np.repeat(np.arange(BATCH_SIZE // 8), 8)  # 4 queries/batch
+
+        def sk_map(p, t):
+            idx = np.tile(base_idx, p.shape[0] // BATCH_SIZE)
+            from sklearn.metrics import average_precision_score
+
+            scores = []
+            for q in np.unique(idx):
+                mask = idx == q
+                if t[mask].sum() > 0:
+                    scores.append(average_precision_score(t[mask], p[mask]))
+                else:
+                    scores.append(0.0)
+            return np.mean(scores)
+
+        preds = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+        target = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+        target[:, ::8] = 1  # every query keeps at least one positive
+        indexes = np.tile(base_idx, (NUM_BATCHES, 1))
+
+        self.run_class_metric_test(
+            ddp=True,
+            preds=preds,
+            target=target,
+            metric_class=RetrievalMAP,
+            sk_metric=sk_map,
+            dist_sync_on_step=True,
+            indexes=indexes,
+        )
+
+
+class TestDistSyncOnStepCatBufferAUROC(MetricTester):
+    """CatBuffer (fixed-capacity cat) state kind via with_capacity()."""
+
+    atol = 1e-6
+
+    def test_auroc_catbuffer_per_step_sync(self):
+        def make(**kwargs):
+            return AUROC(**kwargs).with_capacity(NUM_BATCHES * BATCH_SIZE)
+
+        preds = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+        target = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+        target[:, 0] = 0
+        target[:, 1] = 1
+        self.run_class_metric_test(
+            ddp=True,
+            preds=preds,
+            target=target,
+            metric_class=make,
+            sk_metric=lambda p, t: roc_auc_score(t, p),
+            dist_sync_on_step=True,
+        )
+
+
+def test_wer_per_step_sync():
+    """Text domain: host-side string updates with scalar sum states — a
+    rank's forward value must cover BOTH ranks' step sentences."""
+    import jax.numpy as jnp  # noqa: F401
+
+    from metrics_tpu import WER
+    from metrics_tpu.functional import wer as wer_fn
+    from tests.helpers.testers import _gather_states
+
+    rank0_steps = [(["hello there world"], ["hello the world"]),
+                   (["a b c d"], ["a b x d"])]
+    rank1_steps = [(["one two three"], ["one two tree"]),
+                   (["deep blue sea"], ["deep blue see"])]
+
+    m0 = WER(dist_sync_on_step=True)
+    for (p0, r0), (p1, r1) in zip(rank0_steps, rank1_steps):
+        scratch = WER()
+        scratch.update(p1, r1)
+        other_state = dict(scratch._state)
+
+        def gather(state, reductions):
+            return _gather_states([state, other_state], reductions)
+
+        m0.dist_sync_fn = gather
+        m0.distributed_available_fn = lambda: True
+        step_val = float(m0(p0, r0))
+        expected = float(wer_fn(p0 + p1, r0 + r1))
+        np.testing.assert_allclose(step_val, expected, atol=1e-6)
+    # accumulation stayed local: final value covers only rank 0's sentences
+    m0.dist_sync_fn = None
+    m0.distributed_available_fn = lambda: False
+    all_p0 = [s for step in rank0_steps for s in step[0]]
+    all_r0 = [s for step in rank0_steps for s in step[1]]
+    np.testing.assert_allclose(float(m0.compute()), float(wer_fn(all_p0, all_r0)), atol=1e-6)
+
+
 def test_gather_states_handles_catbuffer():
     """_gather_states must concatenate fixed-capacity CatBuffer states in
     rank order into one buffer, not return a python list of buffers."""

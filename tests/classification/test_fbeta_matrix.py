@@ -1,0 +1,357 @@
+"""FBeta / F1 full input-type × average × mdmc × ignore_index matrix.
+
+Mirror of the reference's `tests/classification/test_f_beta.py`: the 13-row
+input grid × average ∈ {micro, macro, none, weighted, samples} × ignore_index
+∈ {None, 0}, against sklearn's fbeta_score / f1_score composed after the
+shared input formatting, plus wrong-params, zero-division, no-support,
+class-not-present, top-k, and update-vs-functional same-input checks.
+"""
+from functools import partial
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import f1_score, fbeta_score
+
+from metrics_tpu import F1, FBeta
+from metrics_tpu.functional import f1, fbeta
+from metrics_tpu.utils.checks import _input_format_classification
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_logits,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_logits as _input_mcls_logits,
+    _input_multiclass_prob as _input_mcls_prob,
+    _input_multidim_multiclass as _input_mdmc,
+    _input_multidim_multiclass_prob as _input_mdmc_prob,
+    _input_multilabel as _input_mlb,
+    _input_multilabel_logits as _input_mlb_logits,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, THRESHOLD, MetricTester
+
+# int labels with one class removed, preds == target (reference
+# `inputs.py:120-125`): per-class scores for the absent class must be NaN,
+# and averaged scores must agree between accumulate-then-compute and the
+# one-shot functional
+_rng_miss = np.random.RandomState(17)
+_miss_labels = _rng_miss.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_miss_labels[_miss_labels == 1] = 0  # class 1 never appears
+_input_miss_class_preds = _miss_labels.copy()
+_input_miss_class_target = _miss_labels.copy()
+
+
+def _sk_fbeta_f1(preds, target, sk_fn, num_classes, average, multiclass, ignore_index, mdmc_average=None):
+    """Reference `test_f_beta.py:43-65`, with the repo formatter."""
+    if average == "none":
+        average = None
+    if num_classes == 1:
+        average = "binary"
+
+    labels = list(range(num_classes))
+    try:
+        labels.remove(ignore_index)
+    except ValueError:
+        pass
+
+    sk_preds, sk_target, _ = _input_format_classification(
+        preds, target, THRESHOLD, num_classes=num_classes, multiclass=multiclass
+    )
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+    sk_scores = sk_fn(sk_target, sk_preds, average=average, zero_division=0, labels=labels)
+
+    if len(labels) != num_classes and not average:
+        sk_scores = np.insert(sk_scores, ignore_index, np.nan)
+
+    return sk_scores
+
+
+def _sk_fbeta_f1_multidim_multiclass(
+    preds, target, sk_fn, num_classes, average, multiclass, ignore_index, mdmc_average
+):
+    """Reference `test_f_beta.py:67-89`."""
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass
+    )
+    preds, target = np.asarray(preds), np.asarray(target)
+
+    if mdmc_average == "global":
+        preds = np.moveaxis(preds, 1, 2).reshape(-1, preds.shape[1])
+        target = np.moveaxis(target, 1, 2).reshape(-1, target.shape[1])
+        return _sk_fbeta_f1(preds, target, sk_fn, num_classes, average, False, ignore_index)
+    if mdmc_average == "samplewise":
+        scores = []
+        for i in range(preds.shape[0]):
+            scores_i = _sk_fbeta_f1(preds[i].T, target[i].T, sk_fn, num_classes, average, False, ignore_index)
+            scores.append(np.expand_dims(scores_i, 0))
+        return np.concatenate(scores).mean(axis=0)
+    raise ValueError(mdmc_average)
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn",
+    [(partial(FBeta, beta=2.0), partial(fbeta, beta=2.0)), (F1, f1)],
+)
+@pytest.mark.parametrize(
+    "average, mdmc_average, num_classes, ignore_index, match_str",
+    [
+        ("wrong", None, None, None, "`average`"),
+        ("micro", "wrong", None, None, "`mdmc"),
+        ("macro", None, None, None, "number of classes"),
+        ("macro", None, 1, 0, "ignore_index"),
+    ],
+)
+def test_wrong_params(metric_class, metric_fn, average, mdmc_average, num_classes, ignore_index, match_str):
+    """Reference `test_f_beta.py:92-126`."""
+    with pytest.raises(ValueError, match=match_str):
+        metric_class(average=average, mdmc_average=mdmc_average, num_classes=num_classes, ignore_index=ignore_index)
+    with pytest.raises(ValueError, match=match_str):
+        metric_fn(
+            jnp.asarray(_input_binary.preds[0]),
+            jnp.asarray(_input_binary.target[0]),
+            average=average,
+            mdmc_average=mdmc_average,
+            num_classes=num_classes,
+            ignore_index=ignore_index,
+        )
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn",
+    [(partial(FBeta, beta=2.0), partial(fbeta, beta=2.0)), (F1, f1)],
+)
+def test_zero_division(metric_class, metric_fn):
+    """Reference `test_f_beta.py:128-147`."""
+    preds = jnp.asarray([0, 2, 1, 1])
+    target = jnp.asarray([2, 1, 2, 1])
+    cl_metric = metric_class(average="none", num_classes=3)
+    cl_metric(preds, target)
+    assert float(cl_metric.compute()[0]) == float(metric_fn(preds, target, average="none", num_classes=3)[0]) == 0
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn",
+    [(partial(FBeta, beta=2.0), partial(fbeta, beta=2.0)), (F1, f1)],
+)
+def test_no_support(metric_class, metric_fn):
+    """Reference `test_f_beta.py:150-178`."""
+    preds = jnp.asarray([1, 1, 0, 0])
+    target = jnp.asarray([0, 0, 0, 0])
+    cl_metric = metric_class(average="weighted", num_classes=2, ignore_index=0)
+    cl_metric(preds, target)
+    assert float(cl_metric.compute()) == float(
+        metric_fn(preds, target, average="weighted", num_classes=2, ignore_index=0)
+    ) == 0
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn",
+    [(partial(FBeta, beta=2.0), partial(fbeta, beta=2.0)), (F1, f1)],
+)
+@pytest.mark.parametrize("ignore_index, expected", [(None, [1.0, np.nan]), (0, [np.nan, np.nan])])
+def test_class_not_present(metric_class, metric_fn, ignore_index, expected):
+    """Per-class score for a class absent from preds AND target is NaN
+    (reference `test_f_beta.py:181-200`)."""
+    preds = jnp.asarray([0, 0, 0])
+    target = jnp.asarray([0, 0, 0])
+    expected = np.asarray(expected)
+
+    result_fn = np.asarray(metric_fn(preds, target, average="none", num_classes=2, ignore_index=ignore_index))
+    np.testing.assert_allclose(result_fn, expected, equal_nan=True, atol=1e-7)
+
+    cl_metric = metric_class(average="none", num_classes=2, ignore_index=ignore_index)
+    cl_metric(preds, target)
+    np.testing.assert_allclose(np.asarray(cl_metric.compute()), expected, equal_nan=True, atol=1e-7)
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn, sk_fn",
+    [(partial(FBeta, beta=2.0), partial(fbeta, beta=2.0), partial(fbeta_score, beta=2.0)), (F1, f1, f1_score)],
+)
+@pytest.mark.parametrize("average", ["micro", "macro", None, "weighted", "samples"])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass, mdmc_average, sk_wrapper",
+    [
+        (_input_binary_logits.preds, _input_binary_logits.target, 1, None, None, _sk_fbeta_f1),
+        (_input_binary_prob.preds, _input_binary_prob.target, 1, None, None, _sk_fbeta_f1),
+        (_input_binary.preds, _input_binary.target, 1, False, None, _sk_fbeta_f1),
+        (_input_mlb_logits.preds, _input_mlb_logits.target, NUM_CLASSES, None, None, _sk_fbeta_f1),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, NUM_CLASSES, None, None, _sk_fbeta_f1),
+        (_input_mlb.preds, _input_mlb.target, NUM_CLASSES, False, None, _sk_fbeta_f1),
+        (_input_mcls_logits.preds, _input_mcls_logits.target, NUM_CLASSES, None, None, _sk_fbeta_f1),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, NUM_CLASSES, None, None, _sk_fbeta_f1),
+        (_input_multiclass.preds, _input_multiclass.target, NUM_CLASSES, None, None, _sk_fbeta_f1),
+        (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES, None, "global", _sk_fbeta_f1_multidim_multiclass),
+        (
+            _input_mdmc_prob.preds,
+            _input_mdmc_prob.target,
+            NUM_CLASSES,
+            None,
+            "global",
+            _sk_fbeta_f1_multidim_multiclass,
+        ),
+        (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES, None, "samplewise", _sk_fbeta_f1_multidim_multiclass),
+        (
+            _input_mdmc_prob.preds,
+            _input_mdmc_prob.target,
+            NUM_CLASSES,
+            None,
+            "samplewise",
+            _sk_fbeta_f1_multidim_multiclass,
+        ),
+    ],
+)
+class TestFBetaMatrix(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_fbeta_f1_class(
+        self,
+        ddp: bool,
+        preds: np.ndarray,
+        target: np.ndarray,
+        sk_wrapper: Callable,
+        metric_class,
+        metric_fn: Callable,
+        sk_fn: Callable,
+        multiclass: Optional[bool],
+        num_classes: Optional[int],
+        average: str,
+        mdmc_average: Optional[str],
+        ignore_index: Optional[int],
+    ):
+        if num_classes == 1 and average != "micro":
+            pytest.skip("binary data only tests 'micro' (sklearn 'binary') average")
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("ignore_index is undefined for binary inputs")
+        if average == "weighted" and ignore_index is not None and mdmc_average is not None:
+            pytest.skip("ignoring an entire sample under 'weighted' is a degenerate case")
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=metric_class,
+            sk_metric=partial(
+                sk_wrapper,
+                sk_fn=sk_fn,
+                average=average,
+                num_classes=num_classes,
+                multiclass=multiclass,
+                ignore_index=ignore_index,
+                mdmc_average=mdmc_average,
+            ),
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "multiclass": multiclass,
+                "ignore_index": ignore_index,
+                "mdmc_average": mdmc_average,
+            },
+            check_jit=False,  # jit gates for every input type run in test_input_variants
+        )
+
+    def test_fbeta_f1_fn(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        sk_wrapper: Callable,
+        metric_class,
+        metric_fn: Callable,
+        sk_fn: Callable,
+        multiclass: Optional[bool],
+        num_classes: Optional[int],
+        average: str,
+        mdmc_average: Optional[str],
+        ignore_index: Optional[int],
+    ):
+        if num_classes == 1 and average != "micro":
+            pytest.skip("binary data only tests 'micro' (sklearn 'binary') average")
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("ignore_index is undefined for binary inputs")
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=metric_fn,
+            sk_metric=partial(
+                sk_wrapper,
+                sk_fn=sk_fn,
+                average=average,
+                num_classes=num_classes,
+                multiclass=multiclass,
+                ignore_index=ignore_index,
+                mdmc_average=mdmc_average,
+            ),
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "multiclass": multiclass,
+                "ignore_index": ignore_index,
+                "mdmc_average": mdmc_average,
+            },
+        )
+
+
+_mc_k_target = np.asarray([0, 1, 2])
+_mc_k_preds = np.asarray([[0.35, 0.4, 0.25], [0.1, 0.5, 0.4], [0.2, 0.1, 0.7]], dtype=np.float32)
+_ml_k_target = np.asarray([[0, 1, 0], [1, 1, 0], [0, 0, 0]])
+_ml_k_preds = np.asarray([[0.9, 0.2, 0.75], [0.1, 0.7, 0.8], [0.6, 0.1, 0.7]], dtype=np.float32)
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn",
+    [(partial(FBeta, beta=2.0), partial(fbeta, beta=2.0)), (F1, f1)],
+)
+@pytest.mark.parametrize(
+    "k, preds, target, average, expected_fbeta, expected_f1",
+    [
+        (1, _mc_k_preds, _mc_k_target, "micro", 2 / 3, 2 / 3),
+        (2, _mc_k_preds, _mc_k_target, "micro", 5 / 6, 2 / 3),
+        (1, _ml_k_preds, _ml_k_target, "micro", 0.0, 0.0),
+        (2, _ml_k_preds, _ml_k_target, "micro", 5 / 18, 2 / 9),
+    ],
+)
+def test_top_k(metric_class, metric_fn, k, preds, target, average, expected_fbeta, expected_f1):
+    """top_k parity on hand-worked values (reference `test_f_beta.py:387-426`)."""
+    class_metric = metric_class(top_k=k, average=average, num_classes=3)
+    class_metric.update(jnp.asarray(preds), jnp.asarray(target))
+    result = expected_fbeta if class_metric.beta != 1.0 else expected_f1
+    np.testing.assert_allclose(float(class_metric.compute()), result, atol=1e-6)
+    np.testing.assert_allclose(
+        float(metric_fn(jnp.asarray(preds), jnp.asarray(target), top_k=k, average=average, num_classes=3)),
+        result,
+        atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("ignore_index", [None, 2])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+@pytest.mark.parametrize(
+    "metric_class, metric_functional, sk_fn",
+    [(partial(FBeta, beta=2.0), partial(fbeta, beta=2.0), partial(fbeta_score, beta=2.0)), (F1, f1, f1_score)],
+)
+def test_same_input(metric_class, metric_functional, sk_fn, average, ignore_index):
+    """Accumulated class result == one-shot functional == sklearn when preds
+    equal targets with a class missing (reference `test_f_beta.py:429-449`)."""
+    preds, target = _input_miss_class_preds, _input_miss_class_target
+    preds_flat = np.concatenate(list(preds), axis=0)
+    target_flat = np.concatenate(list(target), axis=0)
+
+    mc = metric_class(num_classes=NUM_CLASSES, average=average, ignore_index=ignore_index)
+    for i in range(NUM_BATCHES):
+        mc.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    class_res = np.asarray(mc.compute())
+    func_res = np.asarray(
+        metric_functional(
+            jnp.asarray(preds_flat), jnp.asarray(target_flat),
+            num_classes=NUM_CLASSES, average=average, ignore_index=ignore_index,
+        )
+    )
+    sk_res = sk_fn(target_flat, preds_flat, average=average, zero_division=0)
+
+    np.testing.assert_allclose(class_res, sk_res, atol=1e-6)
+    np.testing.assert_allclose(func_res, sk_res, atol=1e-6)

@@ -1,0 +1,95 @@
+"""HammingDistance across all 13 input variants (incl. multilabel-multidim).
+
+Mirror of the reference's `tests/classification/test_hamming_distance.py`:
+every fixture variant through class (eager + ddp + dist_sync_on_step) and
+functional paths against sklearn's ``hamming_loss`` composed after the shared
+input formatting.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import hamming_loss as sk_hamming_loss
+
+from metrics_tpu import HammingDistance
+from metrics_tpu.functional import hamming_distance
+from metrics_tpu.utils.checks import _input_format_classification
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_logits,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_logits as _input_mcls_logits,
+    _input_multiclass_prob as _input_mcls_prob,
+    _input_multidim_multiclass as _input_mdmc,
+    _input_multidim_multiclass_prob as _input_mdmc_prob,
+    _input_multilabel as _input_mlb,
+    _input_multilabel_logits as _input_mlb_logits,
+    _input_multilabel_multidim as _input_mlmd,
+    _input_multilabel_multidim_prob as _input_mlmd_prob,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import THRESHOLD, MetricTester
+
+
+def _sk_hamming(preds, target):
+    """Reference `test_hamming_distance.py:38-43`, with the repo formatter."""
+    sk_preds, sk_target, _ = _input_format_classification(preds, target, threshold=THRESHOLD)
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+    sk_preds = sk_preds.reshape(sk_preds.shape[0], -1)
+    sk_target = sk_target.reshape(sk_target.shape[0], -1)
+    return sk_hamming_loss(y_true=sk_target, y_pred=sk_preds)
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_input_binary_logits.preds, _input_binary_logits.target),
+        (_input_binary_prob.preds, _input_binary_prob.target),
+        (_input_binary.preds, _input_binary.target),
+        (_input_mlb_logits.preds, _input_mlb_logits.target),
+        (_input_mlb_prob.preds, _input_mlb_prob.target),
+        (_input_mlb.preds, _input_mlb.target),
+        (_input_mcls_logits.preds, _input_mcls_logits.target),
+        (_input_mcls_prob.preds, _input_mcls_prob.target),
+        (_input_multiclass.preds, _input_multiclass.target),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target),
+        (_input_mdmc.preds, _input_mdmc.target),
+        (_input_mlmd_prob.preds, _input_mlmd_prob.target),
+        (_input_mlmd.preds, _input_mlmd.target),
+    ],
+)
+class TestHammingDistanceMatrix(MetricTester):
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_hamming_distance_class(self, ddp, dist_sync_on_step, preds, target):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=HammingDistance,
+            sk_metric=_sk_hamming,
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"threshold": THRESHOLD},
+            check_jit=False,  # jit gates for every input type run in test_input_variants
+        )
+
+    def test_hamming_distance_fn(self, preds, target):
+        self.run_functional_metric_test(
+            preds=preds,
+            target=target,
+            metric_functional=hamming_distance,
+            sk_metric=_sk_hamming,
+            metric_args={"threshold": THRESHOLD},
+        )
+
+
+def test_wrong_params():
+    """threshold outside (0, 1) raises (reference
+    `test_hamming_distance.py:97-108`)."""
+    preds, target = _input_mcls_prob.preds, _input_mcls_prob.target
+    with pytest.raises(ValueError):
+        ham_dist = HammingDistance(threshold=1.5)
+        ham_dist(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+        ham_dist.compute()
+    with pytest.raises(ValueError):
+        hamming_distance(jnp.asarray(preds[0]), jnp.asarray(target[0]), threshold=1.5)

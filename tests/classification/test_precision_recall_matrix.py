@@ -1,0 +1,282 @@
+"""Precision / Recall full input-type × average × mdmc × ignore_index matrix.
+
+Mirror of the reference's `tests/classification/test_precision_recall.py`:
+13-row input grid × average ∈ {micro, macro, none, weighted, samples} ×
+ignore_index ∈ {None, 0}, against sklearn's precision_score / recall_score
+composed after the shared input formatting, plus the wrong-params,
+zero-division, and no-support edge cases.
+"""
+from functools import partial
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_score, recall_score
+
+from metrics_tpu import Precision, Recall
+from metrics_tpu.functional import precision, precision_recall, recall
+from metrics_tpu.utils.checks import _input_format_classification
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_logits,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_logits as _input_mcls_logits,
+    _input_multiclass_prob as _input_mcls_prob,
+    _input_multidim_multiclass as _input_mdmc,
+    _input_multidim_multiclass_prob as _input_mdmc_prob,
+    _input_multilabel as _input_mlb,
+    _input_multilabel_logits as _input_mlb_logits,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_prec_recall(preds, target, sk_fn, num_classes, average, multiclass, ignore_index, mdmc_average=None):
+    """Reference `test_precision_recall.py:43-67`, with the repo formatter."""
+    if average == "none":
+        average = None
+    if num_classes == 1:
+        average = "binary"
+
+    labels = list(range(num_classes))
+    try:
+        labels.remove(ignore_index)
+    except ValueError:
+        pass
+
+    sk_preds, sk_target, _ = _input_format_classification(
+        preds, target, THRESHOLD, num_classes=num_classes, multiclass=multiclass
+    )
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    sk_scores = sk_fn(sk_target, sk_preds, average=average, zero_division=0, labels=labels)
+
+    if len(labels) != num_classes and not average:
+        sk_scores = np.insert(sk_scores, ignore_index, np.nan)
+
+    return sk_scores
+
+
+def _sk_prec_recall_multidim_multiclass(
+    preds, target, sk_fn, num_classes, average, multiclass, ignore_index, mdmc_average
+):
+    """Reference `test_precision_recall.py:70-92`."""
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass
+    )
+    preds, target = np.asarray(preds), np.asarray(target)
+
+    if mdmc_average == "global":
+        preds = np.moveaxis(preds, 1, 2).reshape(-1, preds.shape[1])
+        target = np.moveaxis(target, 1, 2).reshape(-1, target.shape[1])
+        return _sk_prec_recall(preds, target, sk_fn, num_classes, average, False, ignore_index)
+    if mdmc_average == "samplewise":
+        scores = []
+        for i in range(preds.shape[0]):
+            scores_i = _sk_prec_recall(preds[i].T, target[i].T, sk_fn, num_classes, average, False, ignore_index)
+            scores.append(np.expand_dims(scores_i, 0))
+        return np.concatenate(scores).mean(axis=0)
+    raise ValueError(mdmc_average)
+
+
+@pytest.mark.parametrize("metric, fn_metric", [(Precision, precision), (Recall, recall)])
+@pytest.mark.parametrize(
+    "average, mdmc_average, num_classes, ignore_index, match_str",
+    [
+        ("wrong", None, None, None, "`average`"),
+        ("micro", "wrong", None, None, "`mdmc"),
+        ("macro", None, None, None, "number of classes"),
+        ("macro", None, 1, 0, "ignore_index"),
+    ],
+)
+def test_wrong_params(metric, fn_metric, average, mdmc_average, num_classes, ignore_index, match_str):
+    """Invalid average/mdmc_average/num_classes/ignore_index raise with the
+    reference's messages (`test_precision_recall.py:96-131`)."""
+    with pytest.raises(ValueError, match=match_str):
+        metric(average=average, mdmc_average=mdmc_average, num_classes=num_classes, ignore_index=ignore_index)
+    with pytest.raises(ValueError, match=match_str):
+        fn_metric(
+            jnp.asarray(_input_binary.preds[0]),
+            jnp.asarray(_input_binary.target[0]),
+            average=average,
+            mdmc_average=mdmc_average,
+            num_classes=num_classes,
+            ignore_index=ignore_index,
+        )
+    with pytest.raises(ValueError, match=match_str):
+        precision_recall(
+            jnp.asarray(_input_binary.preds[0]),
+            jnp.asarray(_input_binary.target[0]),
+            average=average,
+            mdmc_average=mdmc_average,
+            num_classes=num_classes,
+            ignore_index=ignore_index,
+        )
+
+
+@pytest.mark.parametrize("metric_class, metric_fn", [(Recall, recall), (Precision, precision)])
+def test_zero_division(metric_class, metric_fn):
+    """0/0 class scores come back as 0 (`test_precision_recall.py:134-147`)."""
+    preds = jnp.asarray([0, 2, 1, 1])
+    target = jnp.asarray([2, 1, 2, 1])
+    cl_metric = metric_class(average="none", num_classes=3)
+    cl_metric(preds, target)
+    assert float(cl_metric.compute()[0]) == float(metric_fn(preds, target, average="none", num_classes=3)[0]) == 0
+
+
+@pytest.mark.parametrize("metric_class, metric_fn", [(Recall, recall), (Precision, precision)])
+def test_no_support(metric_class, metric_fn):
+    """weighted average with all support ignored returns zero_division, not NaN
+    (`test_precision_recall.py:150-172`)."""
+    preds = jnp.asarray([1, 1, 0, 0])
+    target = jnp.asarray([0, 0, 0, 0])
+    cl_metric = metric_class(average="weighted", num_classes=2, ignore_index=0)
+    cl_metric(preds, target)
+    assert float(cl_metric.compute()) == float(
+        metric_fn(preds, target, average="weighted", num_classes=2, ignore_index=0)
+    ) == 0
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn, sk_fn", [(Recall, recall, recall_score), (Precision, precision, precision_score)]
+)
+@pytest.mark.parametrize("average", ["micro", "macro", None, "weighted", "samples"])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass, mdmc_average, sk_wrapper",
+    [
+        (_input_binary_logits.preds, _input_binary_logits.target, 1, None, None, _sk_prec_recall),
+        (_input_binary_prob.preds, _input_binary_prob.target, 1, None, None, _sk_prec_recall),
+        (_input_binary.preds, _input_binary.target, 1, False, None, _sk_prec_recall),
+        (_input_mlb_logits.preds, _input_mlb_logits.target, NUM_CLASSES, None, None, _sk_prec_recall),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, NUM_CLASSES, None, None, _sk_prec_recall),
+        (_input_mlb.preds, _input_mlb.target, NUM_CLASSES, False, None, _sk_prec_recall),
+        (_input_mcls_logits.preds, _input_mcls_logits.target, NUM_CLASSES, None, None, _sk_prec_recall),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, NUM_CLASSES, None, None, _sk_prec_recall),
+        (_input_multiclass.preds, _input_multiclass.target, NUM_CLASSES, None, None, _sk_prec_recall),
+        (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES, None, "global", _sk_prec_recall_multidim_multiclass),
+        (
+            _input_mdmc_prob.preds,
+            _input_mdmc_prob.target,
+            NUM_CLASSES,
+            None,
+            "global",
+            _sk_prec_recall_multidim_multiclass,
+        ),
+        (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES, None, "samplewise", _sk_prec_recall_multidim_multiclass),
+        (
+            _input_mdmc_prob.preds,
+            _input_mdmc_prob.target,
+            NUM_CLASSES,
+            None,
+            "samplewise",
+            _sk_prec_recall_multidim_multiclass,
+        ),
+    ],
+)
+class TestPrecisionRecallMatrix(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_precision_recall_class(
+        self,
+        ddp: bool,
+        preds: np.ndarray,
+        target: np.ndarray,
+        sk_wrapper: Callable,
+        metric_class,
+        metric_fn: Callable,
+        sk_fn: Callable,
+        multiclass: Optional[bool],
+        num_classes: Optional[int],
+        average: str,
+        mdmc_average: Optional[str],
+        ignore_index: Optional[int],
+    ):
+        if num_classes == 1 and average != "micro":
+            pytest.skip("binary data only tests 'micro' (sklearn 'binary') average")
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("ignore_index is undefined for binary inputs")
+        if average == "weighted" and ignore_index is not None and mdmc_average is not None:
+            pytest.skip("ignoring an entire sample under 'weighted' is a degenerate case")
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=metric_class,
+            sk_metric=partial(
+                sk_wrapper,
+                sk_fn=sk_fn,
+                average=average,
+                num_classes=num_classes,
+                multiclass=multiclass,
+                ignore_index=ignore_index,
+                mdmc_average=mdmc_average,
+            ),
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "multiclass": multiclass,
+                "ignore_index": ignore_index,
+                "mdmc_average": mdmc_average,
+            },
+            check_jit=False,  # jit gates for every input type run in test_input_variants
+        )
+
+    def test_precision_recall_fn(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        sk_wrapper: Callable,
+        metric_class,
+        metric_fn: Callable,
+        sk_fn: Callable,
+        multiclass: Optional[bool],
+        num_classes: Optional[int],
+        average: str,
+        mdmc_average: Optional[str],
+        ignore_index: Optional[int],
+    ):
+        if num_classes == 1 and average != "micro":
+            pytest.skip("binary data only tests 'micro' (sklearn 'binary') average")
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("ignore_index is undefined for binary inputs")
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=metric_fn,
+            sk_metric=partial(
+                sk_wrapper,
+                sk_fn=sk_fn,
+                average=average,
+                num_classes=num_classes,
+                multiclass=multiclass,
+                ignore_index=ignore_index,
+                mdmc_average=mdmc_average,
+            ),
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "multiclass": multiclass,
+                "ignore_index": ignore_index,
+                "mdmc_average": mdmc_average,
+            },
+        )
+
+
+def test_precision_recall_joint():
+    """`precision_recall` returns the same pair as the two single functionals
+    (reference `test_precision_recall.py:292-305`)."""
+    preds = jnp.asarray(_input_mcls_prob.preds[0])
+    target = jnp.asarray(_input_mcls_prob.target[0])
+    prec, rec = precision_recall(preds, target, average="macro", num_classes=NUM_CLASSES)
+    np.testing.assert_allclose(
+        np.asarray(prec), np.asarray(precision(preds, target, average="macro", num_classes=NUM_CLASSES))
+    )
+    np.testing.assert_allclose(
+        np.asarray(rec), np.asarray(recall(preds, target, average="macro", num_classes=NUM_CLASSES))
+    )

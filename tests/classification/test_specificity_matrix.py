@@ -1,0 +1,275 @@
+"""Specificity full input-type × average × mdmc × ignore_index matrix.
+
+Mirror of the reference's `tests/classification/test_specificity.py`: the
+10-row input grid × average ∈ {micro, macro, none, weighted, samples} ×
+ignore_index ∈ {None, 0}, with the sk reference built from sklearn's
+``multilabel_confusion_matrix`` fp/tn counts pushed through the repo's own
+``_reduce_stat_scores`` (the reference does the same with its reducer), plus
+wrong-params / zero-division / no-support edge cases.
+"""
+from functools import partial
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import multilabel_confusion_matrix
+
+from metrics_tpu import Specificity
+from metrics_tpu.functional import specificity
+from metrics_tpu.functional.classification.stat_scores import _reduce_stat_scores
+from metrics_tpu.utils.checks import _input_format_classification
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob as _input_mcls_prob,
+    _input_multidim_multiclass as _input_mdmc,
+    _input_multidim_multiclass_prob as _input_mdmc_prob,
+    _input_multilabel as _input_mlb,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_stats_score(preds, target, reduce, num_classes, multiclass, ignore_index, top_k):
+    """fp/tn via sklearn, following reference `test_specificity.py:42-81`."""
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass, top_k=top_k
+    )
+    sk_preds, sk_target = np.asarray(preds), np.asarray(target)
+    num_cols = sk_preds.shape[1]
+
+    if reduce != "macro" and ignore_index is not None and num_cols > 1:
+        sk_preds = np.delete(sk_preds, ignore_index, 1)
+        sk_target = np.delete(sk_target, ignore_index, 1)
+
+    if num_cols == 1 and reduce == "samples":
+        sk_target = sk_target.T
+        sk_preds = sk_preds.T
+
+    sk_stats = multilabel_confusion_matrix(
+        sk_target, sk_preds, samplewise=(reduce == "samples") and num_cols != 1
+    )
+
+    if num_cols == 1 and reduce != "samples":
+        sk_stats = sk_stats[[1]].reshape(-1, 4)[:, [3, 1, 0, 2]]
+    else:
+        sk_stats = sk_stats.reshape(-1, 4)[:, [3, 1, 0, 2]]
+
+    if reduce == "micro":
+        sk_stats = sk_stats.sum(axis=0, keepdims=True)
+
+    sk_stats = np.concatenate([sk_stats, sk_stats[:, [3]] + sk_stats[:, [0]]], 1)
+
+    if reduce == "micro":
+        sk_stats = sk_stats[0]
+
+    if reduce == "macro" and ignore_index is not None and num_cols:
+        sk_stats[ignore_index, :] = -1
+
+    if reduce == "micro":
+        _, fp, tn, _, _ = sk_stats
+    else:
+        fp, tn = sk_stats[:, 1], sk_stats[:, 2]
+    return fp, tn
+
+
+def _sk_spec(preds, target, reduce, num_classes, multiclass, ignore_index, top_k=None, mdmc_reduce=None, stats=None):
+    """Reference `test_specificity.py:84-107`, with the repo reducer."""
+    if stats:
+        fp, tn = stats
+    else:
+        fp, tn = _sk_stats_score(preds, target, reduce, num_classes, multiclass, ignore_index, top_k)
+
+    fp, tn = jnp.asarray(np.asarray(fp)), jnp.asarray(np.asarray(tn))
+    spec = _reduce_stat_scores(
+        numerator=tn,
+        denominator=tn + fp,
+        weights=None if reduce != "weighted" else tn + fp,
+        average=reduce,
+        mdmc_average=mdmc_reduce,
+    )
+    if reduce in [None, "none"] and ignore_index is not None:
+        num_cols = np.asarray(
+            _input_format_classification(
+                preds, target, threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass, top_k=top_k
+            )[0]
+        ).shape[1]
+        if num_cols > 1:
+            spec = np.insert(np.asarray(spec), ignore_index, np.nan)
+    return np.asarray(spec)
+
+
+def _sk_spec_mdim_mcls(preds, target, reduce, mdmc_reduce, num_classes, multiclass, ignore_index, top_k=None):
+    """Reference `test_specificity.py:110-128`."""
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass, top_k=top_k
+    )
+    preds, target = np.asarray(preds), np.asarray(target)
+
+    if mdmc_reduce == "global":
+        preds = np.moveaxis(preds, 1, 2).reshape(-1, preds.shape[1])
+        target = np.moveaxis(target, 1, 2).reshape(-1, target.shape[1])
+        return _sk_spec(preds, target, reduce, num_classes, False, ignore_index, top_k, mdmc_reduce)
+
+    fp, tn = [], []
+    for i in range(preds.shape[0]):
+        fp_i, tn_i = _sk_stats_score(preds[i].T, target[i].T, reduce, num_classes, False, ignore_index, top_k)
+        fp.append(fp_i)
+        tn.append(tn_i)
+    return _sk_spec(
+        preds[0], target[0], reduce, num_classes, multiclass, ignore_index, top_k, mdmc_reduce, (fp, tn)
+    )
+
+
+@pytest.mark.parametrize(
+    "average, mdmc_average, num_classes, ignore_index, match_str",
+    [
+        ("wrong", None, None, None, "`average`"),
+        ("micro", "wrong", None, None, "`mdmc"),
+        ("macro", None, None, None, "number of classes"),
+        ("macro", None, 1, 0, "ignore_index"),
+    ],
+)
+def test_wrong_params(average, mdmc_average, num_classes, ignore_index, match_str):
+    """Reference `test_specificity.py:131-159`."""
+    with pytest.raises(ValueError, match=match_str):
+        Specificity(average=average, mdmc_average=mdmc_average, num_classes=num_classes, ignore_index=ignore_index)
+    with pytest.raises(ValueError, match=match_str):
+        specificity(
+            jnp.asarray(_input_binary.preds[0]),
+            jnp.asarray(_input_binary.target[0]),
+            average=average,
+            mdmc_average=mdmc_average,
+            num_classes=num_classes,
+            ignore_index=ignore_index,
+        )
+
+
+def test_zero_division():
+    """Reference `test_specificity.py:161-174`."""
+    preds = jnp.asarray([1, 2, 1, 1])
+    target = jnp.asarray([0, 0, 0, 0])
+    cl_metric = Specificity(average="none", num_classes=3)
+    cl_metric(preds, target)
+    assert float(cl_metric.compute()[0]) == float(specificity(preds, target, average="none", num_classes=3)[0]) == 0
+
+
+def test_no_support():
+    """Reference `test_specificity.py:177-199`."""
+    preds = jnp.asarray([1, 1, 0, 0])
+    target = jnp.asarray([0, 0, 0, 0])
+    cl_metric = Specificity(average="weighted", num_classes=2, ignore_index=1)
+    cl_metric(preds, target)
+    assert float(cl_metric.compute()) == float(
+        specificity(preds, target, average="weighted", num_classes=2, ignore_index=1)
+    ) == 0
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", None, "weighted", "samples"])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass, mdmc_average, sk_wrapper",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, 1, None, None, _sk_spec),
+        (_input_binary.preds, _input_binary.target, 1, False, None, _sk_spec),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, NUM_CLASSES, None, None, _sk_spec),
+        (_input_mlb.preds, _input_mlb.target, NUM_CLASSES, False, None, _sk_spec),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, NUM_CLASSES, None, None, _sk_spec),
+        (_input_multiclass.preds, _input_multiclass.target, NUM_CLASSES, None, None, _sk_spec),
+        (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES, None, "global", _sk_spec_mdim_mcls),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, NUM_CLASSES, None, "global", _sk_spec_mdim_mcls),
+        (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES, None, "samplewise", _sk_spec_mdim_mcls),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, NUM_CLASSES, None, "samplewise", _sk_spec_mdim_mcls),
+    ],
+)
+class TestSpecificityMatrix(MetricTester):
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_specificity_class(
+        self,
+        ddp: bool,
+        preds: np.ndarray,
+        target: np.ndarray,
+        sk_wrapper: Callable,
+        multiclass: Optional[bool],
+        num_classes: Optional[int],
+        average: str,
+        mdmc_average: Optional[str],
+        ignore_index: Optional[int],
+    ):
+        if num_classes == 1 and average != "micro":
+            pytest.skip("binary data only tests 'micro' (sklearn 'binary') average")
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("ignore_index is undefined for binary inputs")
+        if average == "weighted" and ignore_index is not None and mdmc_average is not None:
+            pytest.skip("ignoring an entire sample under 'weighted' is a degenerate case")
+        if mdmc_average == "samplewise":
+            # the sk wrapper recomputes per-sample stats from ALL batches at
+            # once; per-batch forward values cover only that batch
+            check_batch = False
+        else:
+            check_batch = True
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Specificity,
+            sk_metric=partial(
+                sk_wrapper,
+                reduce=average,
+                num_classes=num_classes,
+                multiclass=multiclass,
+                ignore_index=ignore_index,
+                mdmc_reduce=mdmc_average,
+            ),
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "multiclass": multiclass,
+                "ignore_index": ignore_index,
+                "mdmc_average": mdmc_average,
+            },
+            check_batch=check_batch,
+            check_jit=False,  # jit gates for every input type run in test_input_variants
+        )
+
+    def test_specificity_fn(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        sk_wrapper: Callable,
+        multiclass: Optional[bool],
+        num_classes: Optional[int],
+        average: str,
+        mdmc_average: Optional[str],
+        ignore_index: Optional[int],
+    ):
+        if num_classes == 1 and average != "micro":
+            pytest.skip("binary data only tests 'micro' (sklearn 'binary') average")
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("ignore_index is undefined for binary inputs")
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=specificity,
+            sk_metric=partial(
+                sk_wrapper,
+                reduce=average,
+                num_classes=num_classes,
+                multiclass=multiclass,
+                ignore_index=ignore_index,
+                mdmc_reduce=mdmc_average,
+            ),
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "multiclass": multiclass,
+                "ignore_index": ignore_index,
+                "mdmc_average": mdmc_average,
+            },
+        )

@@ -66,17 +66,32 @@ def _gather_states(states: Sequence[Dict[str, Any]], reductions: Dict[str, Any])
     out: Dict[str, Any] = {}
     for name, red in reductions.items():
         vals = [s[name] for s in states]
-        if isinstance(vals[0], list):  # cat-list state: concat in rank order
-            out[name] = [x for v in vals for x in v]
-        elif isinstance(vals[0], CatBuffer):  # fixed-capacity cat state
+        if isinstance(vals[0], CatBuffer) and all(isinstance(v, CatBuffer) for v in vals):
+            # fixed-capacity cat state on every rank
             gathered = CatBuffer(sum(v.capacity for v in vals))
             for v in vals:
                 gathered = gathered.merge(v)
             out[name] = gathered
+        elif isinstance(vals[0], (list, CatBuffer)):
+            # cat states, possibly mixed: forward's batch state for a
+            # CatBuffer metric is a plain per-batch list (O(batch) updates,
+            # `core/metric.py` forward docstring) while other ranks hand over
+            # CatBuffers — flatten everything to one rank-ordered chunk list
+            chunks: list = []
+            for v in vals:
+                if isinstance(v, CatBuffer):
+                    chunks.append(v.values())
+                else:
+                    chunks.extend(v)
+            out[name] = chunks
         elif red == "sum":
             out[name] = sum(vals[1:], vals[0])
         elif red == "mean":
             out[name] = sum(vals[1:], vals[0]) / len(vals)
+        elif red == "min":
+            out[name] = jnp.min(jnp.stack([jnp.asarray(v) for v in vals]), axis=0)
+        elif red == "max":
+            out[name] = jnp.max(jnp.stack([jnp.asarray(v) for v in vals]), axis=0)
         elif red == "cat":
             out[name] = jnp.concatenate([jnp.asarray(v) for v in vals], axis=0)
         elif callable(red):

@@ -1,0 +1,65 @@
+"""CPU perf-regression smoke: the config-1 fused step under a generous bound.
+
+VERDICT r2 item 10: a pytest-marked micro-bench so hot-path regressions
+surface between hardware windows. Methodology is the scan-slope timing from
+``docs/performance.md`` / `bench.py:114-153` (K steps in one jitted program,
+per-step = slope between two Ks, medians over repeats), with a ~20×
+headroom over the measured 60-66 µs/step so suite-load noise never flaps it.
+
+Run explicitly with ``pytest -m perf`` — it is part of the default run too
+(cheap: <10 s), but the marker lets perf-only sweeps select it.
+"""
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax import lax
+
+from metrics_tpu import Accuracy, MetricCollection, StatScores
+
+BATCH = 2048
+NUM_CLASSES = 10
+# measured 60-66 µs/step on this CPU (BENCH_r02/r03); regressions we care
+# about (accidental host sync, retrace per step, de-fused update) are 10-1000×
+CEILING_US = 1500.0
+
+
+@pytest.mark.perf
+def test_fused_step_time_under_cpu_ceiling():
+    mc = MetricCollection(
+        {"acc": Accuracy(num_classes=NUM_CLASSES), "stats": StatScores(reduce="macro", num_classes=NUM_CLASSES)}
+    )
+    rng = np.random.RandomState(0)
+    import jax.numpy as jnp
+
+    preds = jnp.asarray(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, (BATCH,)))
+
+    medians = {}
+    for k in (100, 800):
+
+        @jax.jit
+        def run(s0, k=k):
+            # per-step perturbation keeps the body loop-VARIANT so XLA cannot
+            # hoist the statistics computation out of the scan (the same trick
+            # as bench.py's `perturb`) — without it the guard measures nothing
+            def body(s, i):
+                return mc.pure_update(s, preds + i * 1e-9, target), None
+
+            return lax.scan(body, s0, jnp.arange(k, dtype=jnp.float32))[0]
+
+        state0 = mc.init_state()
+        jax.block_until_ready(run(state0))  # compile outside the timing
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(run(state0))
+            ts.append(time.perf_counter() - t0)
+        medians[k] = sorted(ts)[len(ts) // 2]
+
+    per_step_us = max(medians[800] - medians[100], 0.0) / 700 * 1e6
+    assert per_step_us < CEILING_US, (
+        f"fused metric step regressed: {per_step_us:.1f} µs/step on CPU "
+        f"(ceiling {CEILING_US} µs; healthy is ~60-70 µs — see docs/performance.md)"
+    )
