@@ -104,17 +104,9 @@ def fbeta(
         >>> print(round(float(fbeta(preds, target, num_classes=3, beta=0.5)), 4))
         0.3333
     """
-    allowed_average = list(AvgMethod)
-    if average not in allowed_average:
-        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
-    allowed_mdmc_average = [None, "samplewise", "global"]
-    if mdmc_average not in allowed_mdmc_average:
-        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
-    if average in ["macro", "weighted", "none", None] and (not num_classes or num_classes < 1):
-        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
-    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
-        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+    from metrics_tpu.functional.classification.precision_recall import _check_prf_args
 
+    _check_prf_args(average, mdmc_average, num_classes, ignore_index)
     reduce = "macro" if average in ["weighted", "none", None] else average
     tp, fp, tn, fn = _stat_scores_update(
         preds, target, reduce=reduce, mdmc_reduce=mdmc_average, threshold=threshold,

@@ -65,7 +65,10 @@ class PSNR(Metric):
             self.add_state("min_target", jnp.zeros(()), dist_reduce_fx="min")
             self.add_state("max_target", jnp.zeros(()), dist_reduce_fx="max")
         else:
-            self.add_state("data_range", jnp.asarray(float(data_range)), dist_reduce_fx="mean")
+            # constant across ranks, so 'max' ≡ the reference's 'mean' under
+            # sync (`psnr.py:103`) — and unlike mean it has an exact algebraic
+            # merge, so the merge-based forward/merge_state paths work too
+            self.add_state("data_range", jnp.asarray(float(data_range)), dist_reduce_fx="max")
         self.base = base
         self.reduction = reduction
         self.dim = tuple(dim) if isinstance(dim, Sequence) else dim

@@ -1,0 +1,144 @@
+"""PIT grid vs a scipy linear-sum-assignment reference.
+
+Mirror of the reference's `tests/audio/test_pit.py`: 2- and 3-speaker inputs
+× {snr, si_sdr} × eval_func, through class (eager + ddp + per-step sync),
+functional, permutate round-trip, and the error contracts. The scipy naive
+implementation is the ground truth (`test_pit.py:49-82`).
+"""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.optimize import linear_sum_assignment
+
+from metrics_tpu import PIT
+from metrics_tpu.functional import pit, pit_permutate, si_sdr, snr
+from tests.helpers.testers import MetricTester
+
+NUM_BATCHES = 10  # must match tests.helpers.testers.NUM_BATCHES (tester iterates it)
+BATCH = 8
+TIME = 10
+rng = np.random.RandomState(42)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+# 3 speakers exercises the assignment solver; 2 the exhaustive path
+inputs3 = Input(
+    preds=rng.rand(NUM_BATCHES, BATCH, 3, TIME).astype(np.float32),
+    target=rng.rand(NUM_BATCHES, BATCH, 3, TIME).astype(np.float32),
+)
+inputs2 = Input(
+    preds=rng.rand(NUM_BATCHES, BATCH, 2, TIME).astype(np.float32),
+    target=rng.rand(NUM_BATCHES, BATCH, 2, TIME).astype(np.float32),
+)
+
+
+def _np_metric(name):
+    def _snr(p, t):
+        p64, t64 = p.astype(np.float64), t.astype(np.float64)
+        return 10 * np.log10(np.sum(t64**2, -1) / np.sum((p64 - t64) ** 2, -1))
+
+    def _si_sdr(p, t):
+        p64, t64 = p.astype(np.float64), t.astype(np.float64)
+        alpha = np.sum(p64 * t64, -1, keepdims=True) / np.sum(t64**2, -1, keepdims=True)
+        s = alpha * t64
+        e = p64 - s
+        return 10 * np.log10(np.sum(s**2, -1) / np.sum(e**2, -1))
+
+    return _snr if name == "snr" else _si_sdr
+
+
+def naive_pit_scipy(preds, target, metric_name, eval_func):
+    """Reference `test_pit.py:49-82`: full pairwise matrix + scipy assignment."""
+    fn = _np_metric(metric_name)
+    b, spk = target.shape[0], target.shape[1]
+    mtx = np.empty((b, spk, spk))
+    for t in range(spk):
+        for e in range(spk):
+            mtx[:, t, e] = fn(preds[:, e], target[:, t])
+    best = []
+    for i in range(b):
+        row, col = linear_sum_assignment(mtx[i], eval_func == "max")
+        best.append(mtx[i, row, col].mean())
+    return np.asarray(best)
+
+
+def _average_pit(preds, target, metric_name, eval_func):
+    return naive_pit_scipy(preds, target, metric_name, eval_func).mean()
+
+
+@pytest.mark.parametrize(
+    "preds, target, metric_func, metric_name, eval_func",
+    [
+        (inputs3.preds, inputs3.target, snr, "snr", "max"),
+        (inputs3.preds, inputs3.target, si_sdr, "si_sdr", "max"),
+        (inputs2.preds, inputs2.target, snr, "snr", "max"),
+        (inputs2.preds, inputs2.target, si_sdr, "si_sdr", "max"),
+        (inputs2.preds, inputs2.target, snr, "snr", "min"),
+    ],
+    ids=["snr3", "si_sdr3", "snr2", "si_sdr2", "snr2_min"],
+)
+class TestPITMatrix(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_pit_class(self, preds, target, metric_func, metric_name, eval_func, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=PIT,
+            sk_metric=partial(_average_pit, metric_name=metric_name, eval_func=eval_func),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args=dict(metric_func=metric_func, eval_func=eval_func),
+            check_jit=False,  # jit of the exhaustive path is covered below
+        )
+
+    def test_pit_functional(self, preds, target, metric_func, metric_name, eval_func):
+        for i in range(NUM_BATCHES):
+            best, perm = pit(jnp.asarray(preds[i]), jnp.asarray(target[i]), metric_func, eval_func)
+            expected = naive_pit_scipy(preds[i], target[i], metric_name, eval_func)
+            np.testing.assert_allclose(np.asarray(best), expected, atol=1e-4)
+
+    def test_pit_permutate_roundtrip(self, preds, target, metric_func, metric_name, eval_func):
+        """Reordering preds by the returned perm makes the identity
+        assignment optimal."""
+        p, t = jnp.asarray(preds[0]), jnp.asarray(target[0])
+        best, perm = pit(p, t, metric_func, eval_func)
+        reordered = pit_permutate(p, perm)
+        direct = metric_func(reordered, t)
+        np.testing.assert_allclose(np.asarray(direct).mean(), float(np.asarray(best).mean()), atol=1e-4)
+
+
+def test_error_on_different_shape():
+    metric = PIT(snr, "max")
+    with pytest.raises(RuntimeError, match="expected to have the same shape"):
+        metric(jnp.asarray(rng.rand(3, 3, 10)), jnp.asarray(rng.rand(3, 2, 10)))
+
+
+def test_error_on_wrong_eval_func():
+    metric = PIT(snr, "xxx")
+    with pytest.raises(ValueError):
+        metric(jnp.asarray(rng.rand(3, 3, 10)), jnp.asarray(rng.rand(3, 3, 10)))
+
+
+def test_error_on_wrong_shape():
+    metric = PIT(snr, "max")
+    with pytest.raises(ValueError):
+        metric(jnp.asarray(rng.rand(3)), jnp.asarray(rng.rand(3)))
+
+
+def test_consistency_exhaustive_vs_hungarian():
+    """The jitted exhaustive search and the Hungarian host-callback agree
+    (reference `test_pit.py:184-196`)."""
+    from metrics_tpu.functional.audio.pit import _best_perm_exhaustive, _best_perm_hungarian
+
+    for shp in [(5, 2, 2), (4, 3, 3), (4, 4, 4), (3, 5, 5)]:
+        mtx = jnp.asarray(rng.randn(*shp).astype(np.float32))
+        bm1, bp1 = _best_perm_exhaustive(mtx, maximize=True)
+        bm2, bp2 = _best_perm_hungarian(mtx, maximize=True)
+        np.testing.assert_allclose(np.asarray(bm1), np.asarray(bm2), atol=1e-5)
+        assert np.array_equal(np.asarray(bp1), np.asarray(bp2))

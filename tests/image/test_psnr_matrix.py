@@ -1,0 +1,89 @@
+"""PSNR grid: data_range/base/dim axes × ddp × dist_sync_on_step.
+
+Mirror of the reference's `tests/image/test_psnr.py:77-138` matrix, with the
+sk reference hand-rolled in numpy (the formula is closed-form; the reference
+leans on skimage, which this image does not ship).
+"""
+from collections import namedtuple
+from functools import partial
+
+import numpy as np
+import pytest
+
+from metrics_tpu import PSNR
+from metrics_tpu.functional import psnr
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+rng = np.random.RandomState(42)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_input_size = (NUM_BATCHES, BATCH_SIZE, 4, 4)
+_inputs = [
+    Input(
+        preds=(rng.randint(n_cls_pred, size=_input_size) / n_cls_pred).astype(np.float32),
+        target=(rng.randint(n_cls_target, size=_input_size) / n_cls_target).astype(np.float32),
+    )
+    for n_cls_pred, n_cls_target in [(10, 10), (5, 10), (10, 5)]
+]
+
+
+def _np_psnr(preds, target, data_range, base):
+    mse = np.mean((preds.astype(np.float64) - target) ** 2)
+    return 10 * np.log10(data_range**2 / mse) / np.log10(base)
+
+
+def _np_psnr_dim(preds, target, data_range, base):
+    """dim=(1,2) on [B,H,W] batches: per-image PSNR, mean-reduced (matches
+    reduction 'elementwise_mean' over the kept batch axis)."""
+    p = preds.reshape(preds.shape[0], -1).astype(np.float64)
+    t = target.reshape(target.shape[0], -1)
+    mse = np.mean((p - t) ** 2, axis=1)
+    vals = 10 * np.log10(data_range**2 / mse) / np.log10(base)
+    return vals.mean()
+
+
+@pytest.mark.parametrize(
+    "preds, target, data_range, dim",
+    [
+        (_inputs[0].preds, _inputs[0].target, 1.0, None),
+        (_inputs[1].preds, _inputs[1].target, 1.0, None),
+        (_inputs[2].preds, _inputs[2].target, 0.5, None),
+        (_inputs[2].preds, _inputs[2].target, 0.5, (1, 2)),
+    ],
+)
+@pytest.mark.parametrize("base", [10.0, 2.718281828459045])
+class TestPSNRMatrix(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_psnr_class(self, preds, target, data_range, dim, base, ddp, dist_sync_on_step):
+        sk = _np_psnr if dim is None else _np_psnr_dim
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=PSNR,
+            sk_metric=partial(sk, data_range=data_range, base=base),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"data_range": data_range, "base": base, "dim": dim},
+            check_jit=False,  # jit covered in test_image.py
+        )
+
+    def test_psnr_functional(self, preds, target, data_range, dim, base):
+        sk = _np_psnr if dim is None else _np_psnr_dim
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=psnr,
+            sk_metric=partial(sk, data_range=data_range, base=base),
+            metric_args={"data_range": data_range, "base": base, "dim": dim},
+        )
+
+
+@pytest.mark.parametrize("reduction", ["none", "sum"])
+def test_reduction_for_dim_none_warns(reduction):
+    """Reference `test_psnr.py:134-138`."""
+    with pytest.warns(UserWarning, match="will not have any effect"):
+        PSNR(reduction=reduction, dim=None)

@@ -1,0 +1,181 @@
+"""Retrieval metrics randomized grid vs numpy group-loop references.
+
+Mirror of the reference's `tests/retrieval/helpers.py` +
+`test_{map,mrr,precision,recall,fallout,ndcg}.py` strategy: random scores
+grouped into queries, scored per group by an sk/numpy reference loop
+(`helpers.py:70-110`), swept over k and empty_target_action, through class
+(eager + ddp), functional, and argument-validation axes. Indexes use a fixed
+per-batch pattern so the sk reference can rebuild the query assignment from
+row count alone (the tester's sk seam passes only preds/target).
+"""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, ndcg_score
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from tests.helpers.testers import BATCH_SIZE, MetricTester
+
+NUM_BATCHES = 10
+QUERIES_PER_BATCH = 4
+_base_idx = np.repeat(np.arange(QUERIES_PER_BATCH), BATCH_SIZE // QUERIES_PER_BATCH)
+
+rng = np.random.RandomState(77)
+_preds = rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32)
+_target = rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE))
+_target[:, ::BATCH_SIZE // QUERIES_PER_BATCH] = 1      # every query has a positive
+_target[:, 1::BATCH_SIZE // QUERIES_PER_BATCH] = 0     # ... and a negative (fallout)
+_indexes = np.tile(_base_idx, (NUM_BATCHES, 1))
+
+
+# -- per-query numpy references (reference helpers.py mapping) --------------
+def _np_ap(t, p):
+    return average_precision_score(t, p)
+
+
+def _np_rr(t, p):
+    order = np.argsort(-p)
+    ranked = t[order]
+    first = np.flatnonzero(ranked)
+    return 0.0 if first.size == 0 else 1.0 / (first[0] + 1)
+
+
+def _np_precision_at_k(t, p, k):
+    k = k or t.size
+    top = np.argsort(-p)[:k]
+    return t[top].sum() / k
+
+
+def _np_recall_at_k(t, p, k):
+    k = k or t.size
+    top = np.argsort(-p)[:k]
+    return t[top].sum() / max(t.sum(), 1)
+
+
+def _np_fallout_at_k(t, p, k):
+    k = k or t.size
+    top = np.argsort(-p)[:k]
+    neg = 1 - t
+    return neg[top].sum() / max(neg.sum(), 1)
+
+
+def _np_ndcg_at_k(t, p, k):
+    return ndcg_score(t[None, :], p[None, :], k=k)
+
+
+def _group_loop(preds, target, per_query, empty="skip", empty_on="positives"):
+    """Score each query, handling empties like the reference's
+    ``_compute_sklearn_metric`` (skip / count-as-0 via 'neg' / 'pos')."""
+    idx = np.tile(_base_idx, preds.shape[0] // BATCH_SIZE)
+    scores = []
+    for q in np.unique(idx):
+        mask = idx == q
+        t, p = target[mask], preds[mask]
+        relevant = t.sum() if empty_on == "positives" else (1 - t).sum()
+        if relevant == 0:
+            if empty == "skip":
+                continue
+            scores.append(0.0 if empty == "neg" else 1.0)
+            continue
+        scores.append(per_query(t, p))
+    return np.mean(scores) if scores else 0.0
+
+
+_CASES = [
+    # (name, metric_class, functional, per_query(t,p,k) -> score, k values, empty_on)
+    ("map", RetrievalMAP, retrieval_average_precision, lambda t, p, k=None: _np_ap(t, p), [None], "positives"),
+    ("mrr", RetrievalMRR, retrieval_reciprocal_rank, lambda t, p, k=None: _np_rr(t, p), [None], "positives"),
+    ("precision", RetrievalPrecision, retrieval_precision, _np_precision_at_k, [None, 1, 4, 10], "positives"),
+    ("recall", RetrievalRecall, retrieval_recall, _np_recall_at_k, [None, 1, 4, 10], "positives"),
+    ("fallout", RetrievalFallOut, retrieval_fall_out, _np_fallout_at_k, [None, 1, 4, 10], "negatives"),
+    ("ndcg", RetrievalNormalizedDCG, retrieval_normalized_dcg, _np_ndcg_at_k, [None, 1, 4, 10], "positives"),
+]
+
+
+@pytest.mark.parametrize(
+    "name, metric_class, functional, per_query, ks, empty_on",
+    _CASES,
+    ids=[c[0] for c in _CASES],
+)
+class TestRetrievalMatrix(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("k", [None, 4])
+    def test_class(self, ddp, k, name, metric_class, functional, per_query, ks, empty_on):
+        if k is not None and k not in ks:
+            pytest.skip(f"{name} takes no k argument")
+        args = {"empty_target_action": "skip"}
+        if k is not None:
+            args["k"] = k
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_preds,
+            target=_target,
+            metric_class=metric_class,
+            sk_metric=partial(
+                _group_loop, per_query=lambda t, p: per_query(t, p, k), empty_on=empty_on
+            ),
+            metric_args=args,
+            check_batch=False,  # per-batch groups differ from global groups
+            check_jit=False,  # jittable path covered in test_retrieval.py
+            indexes=_indexes,
+        )
+
+    @pytest.mark.parametrize("k", [None, 1, 4, 10])
+    def test_functional_single_query(self, k, name, metric_class, functional, per_query, ks, empty_on):
+        """Functional form on one query at a time vs the numpy reference."""
+        if k is not None and k not in ks:
+            pytest.skip(f"{name} takes no k argument")
+        for b in range(3):
+            for q in range(QUERIES_PER_BATCH):
+                mask = _base_idx == q
+                t, p = _target[b][mask], _preds[b][mask]
+                kwargs = {} if k is None else {"k": k}
+                ours = float(functional(jnp.asarray(p), jnp.asarray(t), **kwargs))
+                expected = per_query(t, p, k)
+                np.testing.assert_allclose(ours, expected, atol=1e-6, err_msg=f"{name} b={b} q={q} k={k}")
+
+    def test_invalid_k_raises(self, name, metric_class, functional, per_query, ks, empty_on):
+        if ks == [None]:
+            pytest.skip(f"{name} takes no k argument")
+        for bad in (0, -2):
+            with pytest.raises(ValueError):
+                metric_class(k=bad)
+
+
+@pytest.mark.parametrize("empty_action", ["skip", "neg", "pos"])
+def test_empty_target_actions_map(empty_action):
+    """Hand-worked empty-query policies: 4 queries, one with no positives.
+
+    skip → mean over 3 scored queries; neg → empty counts 0; pos → counts 1.
+    """
+    # q0: perfect (ap 1.0), q1: ap 0.5, q2: ap 0.75, q3: EMPTY targets
+    preds = jnp.asarray([0.9, 0.1, 0.8, 0.9, 0.7, 0.6, 0.2, 0.1])
+    target = jnp.asarray([1, 0, 0, 1, 1, 0, 0, 0])
+    indexes = jnp.asarray([0, 0, 1, 1, 2, 2, 3, 3])
+    m = RetrievalMAP(empty_target_action=empty_action)
+    m.update(preds, target, indexes=indexes)
+    ap1 = average_precision_score([0, 1], [0.8, 0.9])
+    ap2 = average_precision_score([1, 0], [0.7, 0.6])
+    scores = {"skip": np.mean([1.0, ap1, ap2]),
+              "neg": np.mean([1.0, ap1, ap2, 0.0]),
+              "pos": np.mean([1.0, ap1, ap2, 1.0])}
+    np.testing.assert_allclose(float(m.compute()), scores[empty_action], atol=1e-6)

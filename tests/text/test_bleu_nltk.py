@@ -1,0 +1,89 @@
+"""BLEU parity against nltk's corpus_bleu — the reference's own oracle.
+
+Mirror of `tests/text/test_blue.py`: the nltk documentation corpora through
+n_gram ∈ {1..4} × smoothing, functional and class (accumulation + ddp-style
+merge), checked against ``nltk.translate.bleu_score.corpus_bleu``.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+
+nltk = pytest.importorskip("nltk", reason="nltk provides the BLEU oracle (reference test_blue.py does the same)")
+from nltk.translate.bleu_score import SmoothingFunction, corpus_bleu  # noqa: E402
+
+from metrics_tpu import BLEUScore
+from metrics_tpu.functional import bleu_score
+
+HYPOTHESIS_A = tuple(
+    "It is a guide to action which ensures that the military always obeys the commands of the party".split()
+)
+REFERENCE_1A = tuple("It is a guide to action that ensures that the military will forever heed Party commands".split())
+REFERENCE_2A = tuple(
+    "It is a guiding principle which makes the military forces always being under the command of the Party".split()
+)
+REFERENCE_3A = tuple("It is the practical guide for the army always to heed the directions of the party".split())
+
+HYPOTHESIS_B = tuple("he read the book because he was interested in world history".split())
+REFERENCE_1B = tuple("he was interested in world history because he read the book".split())
+
+HYPOTHESIS_C = tuple("the cat the cat on the mat".split())
+REFERENCE_1C = tuple("the cat is on the mat".split())
+REFERENCE_2C = tuple("there is a cat on the mat".split())
+
+# two "batches" of (references, hypotheses) like the reference's BATCHES dict
+_TARGETS = [
+    [[REFERENCE_1A, REFERENCE_2A, REFERENCE_3A], [REFERENCE_1B]],
+    [[REFERENCE_1B], [REFERENCE_1C, REFERENCE_2C]],
+]
+_PREDS = [
+    [HYPOTHESIS_A, HYPOTHESIS_B],
+    [HYPOTHESIS_B, HYPOTHESIS_C],
+]
+
+_smooth2 = SmoothingFunction().method2  # add-one for orders > 1 == our smooth=True
+
+
+@pytest.mark.parametrize(
+    "weights, n_gram, smooth_func, smooth",
+    [
+        ([1.0], 1, None, False),
+        ([0.5, 0.5], 2, _smooth2, True),
+        ([1 / 3] * 3, 3, None, False),
+        ([0.25] * 4, 4, _smooth2, True),
+    ],
+    ids=["1gram", "2gram_smooth", "3gram", "4gram_smooth"],
+)
+class TestBLEUvsNLTK:
+    def test_functional_corpus(self, weights, n_gram, smooth_func, smooth):
+        """Whole corpus in one call vs corpus_bleu."""
+        all_refs = [r for batch in _TARGETS for r in batch]
+        all_hyps = [h for batch in _PREDS for h in batch]
+        expected = corpus_bleu(all_refs, all_hyps, weights=weights, smoothing_function=smooth_func)
+        ours = float(bleu_score(all_refs, all_hyps, n_gram=n_gram, smooth=smooth))
+        np.testing.assert_allclose(ours, expected, atol=1e-6)
+
+    @pytest.mark.parametrize("world", [1, 2])
+    def test_class_accumulation_matches_corpus(self, weights, n_gram, smooth_func, smooth, world):
+        """Batch-wise update (one metric per simulated rank, states merged)
+        equals corpus_bleu over everything at once."""
+        metrics = [BLEUScore(n_gram=n_gram, smooth=smooth) for _ in range(world)]
+        for i, (refs, hyps) in enumerate(zip(_TARGETS, _PREDS)):
+            metrics[i % world].update(refs, hyps)
+        merged = metrics[0]
+        for other in metrics[1:]:
+            merged.merge_state(other)
+        all_refs = [r for batch in _TARGETS for r in batch]
+        all_hyps = [h for batch in _PREDS for h in batch]
+        expected = corpus_bleu(all_refs, all_hyps, weights=weights, smoothing_function=smooth_func)
+        np.testing.assert_allclose(float(merged.compute()), expected, atol=1e-6)
+
+
+def test_nltk_example_sentence_level_zero_overlap():
+    """Degenerate candidate with no 4-gram overlap: both nltk (unsmoothed)
+    and ours go to 0."""
+    refs = [[REFERENCE_1C, REFERENCE_2C]]
+    hyps = [tuple("completely unrelated words here now".split())]
+    expected = corpus_bleu(refs, hyps, weights=[0.25] * 4)
+    ours = float(bleu_score(refs, hyps, n_gram=4, smooth=False))
+    np.testing.assert_allclose(ours, expected, atol=1e-6)
