@@ -1,0 +1,38 @@
+"""docs/reference staleness gate: the committed pages must match a fresh
+regeneration from live docstrings (docs/generate_reference.py), run exactly
+as documented (`python docs/generate_reference.py`) in a subprocess so the
+script's own bootstrap is what gets tested and nothing leaks into this
+interpreter."""
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+REF_DIR = os.path.join(REPO, "docs", "reference")
+GEN = os.path.join(REPO, "docs", "generate_reference.py")
+
+
+def test_reference_pages_are_fresh(tmp_path):
+    if not os.path.isdir(REF_DIR):
+        pytest.fail("docs/reference missing — run `python docs/generate_reference.py`")
+    scratch_docs = tmp_path / "docs"
+    scratch_docs.mkdir()
+    shutil.copy(GEN, scratch_docs / "generate_reference.py")
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.run(
+        [sys.executable, str(scratch_docs / "generate_reference.py")],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert proc.returncode == 0, f"generator failed:\n{proc.stdout}\n{proc.stderr}"
+    fresh_dir = scratch_docs / "reference"
+    committed = sorted(os.listdir(REF_DIR))
+    fresh = sorted(os.listdir(fresh_dir))
+    assert committed == fresh, f"page set drifted: {committed} vs {fresh}"
+    for name in committed:
+        with open(os.path.join(REF_DIR, name)) as a, open(fresh_dir / name) as b:
+            assert a.read() == b.read(), (
+                f"docs/reference/{name} is stale — re-run `python docs/generate_reference.py`"
+            )
