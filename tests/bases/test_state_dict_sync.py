@@ -1,0 +1,119 @@
+"""Synced-vs-local state_dict semantics (reference `tests/bases/test_ddp.py:
+106-238` `_test_state_dict_is_synced`, run here with an injected 2-rank
+gather instead of a process pool).
+
+The contract: while synced, ``state_dict`` snapshots the GLOBAL (reduced)
+state; after ``unsync`` it snapshots the LOCAL accumulation again, and the
+sync/unsync state machine raises on double transitions exactly like the
+reference.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from tests.helpers.testers import _gather_states
+
+
+class DummyCatMetric(Metric):
+    """Reference `test_ddp.py:109-120`: a sum state + a count state."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("x", jnp.zeros(()), dist_reduce_fx="sum", persistent=True)
+        self.add_state("c", jnp.zeros(()), dist_reduce_fx="sum", persistent=True)
+
+    def update(self, x):
+        self.x = self.x + x
+        self.c = self.c + 1
+
+    def compute(self):
+        return self.x
+
+
+def _make_two_ranks(steps):
+    """Rank 0 is the metric under test; rank 1's states are gathered in."""
+    m = DummyCatMetric()
+    other = DummyCatMetric()
+    for i in range(steps):
+        m.update(jnp.asarray(float(i)))
+        other.update(jnp.asarray(float(i)))
+
+    def gather(state, reductions):
+        return _gather_states([state, dict(other._state)], reductions)
+
+    m.dist_sync_fn = gather
+    m.distributed_available_fn = lambda: True
+    return m
+
+
+def test_state_dict_synced_vs_local():
+    steps = 5
+    exp_sum = sum(range(steps))
+    m = _make_two_ranks(steps)
+
+    # local snapshot
+    sd = m.state_dict()
+    assert float(sd["x"]) == exp_sum and float(sd["c"]) == steps
+
+    # synced snapshot carries the 2-rank global state
+    m.sync()
+    assert m._is_synced
+    sd = m.state_dict()
+    assert float(sd["x"]) == 2 * exp_sum and float(sd["c"]) == 2 * steps
+
+    # reload of the synced snapshot resumes from GLOBAL totals
+    m2 = DummyCatMetric()
+    m2.load_state_dict(sd)
+    assert float(m2.compute()) == 2 * exp_sum
+
+    # unsync restores the local accumulation
+    m.unsync()
+    assert not m._is_synced
+    sd = m.state_dict()
+    assert float(sd["x"]) == exp_sum and float(sd["c"]) == steps
+
+
+def test_sync_state_machine_guards():
+    m = _make_two_ranks(3)
+    m.sync()
+    with pytest.raises(MetricsTPUUserError, match="already been synced"):
+        m.sync()
+    with pytest.raises(MetricsTPUUserError, match="shouldn't be synced"):
+        m(jnp.asarray(1.0))
+    m.unsync()
+    with pytest.raises(MetricsTPUUserError, match="already been un-synced"):
+        m.unsync()
+
+
+def test_sync_context_snapshots_then_restores():
+    steps = 4
+    exp_sum = sum(range(steps))
+    m = _make_two_ranks(steps)
+    with m.sync_context():
+        assert m._is_synced
+        assert float(m.state_dict()["x"]) == 2 * exp_sum
+    assert not m._is_synced
+    assert float(m.state_dict()["x"]) == exp_sum
+
+    with m.sync_context(should_unsync=False):
+        assert m._is_synced
+    assert m._is_synced  # stays synced when asked
+    m.unsync()
+
+    # accumulation continues correctly after the round-trips
+    m.update(jnp.asarray(10.0))
+    assert float(m.state_dict()["x"]) == exp_sum + 10
+
+
+def test_unsync_without_cache_raises():
+    m = _make_two_ranks(2)
+    m.sync()
+    cache = m._cache
+    m._cache = None
+    with pytest.raises(MetricsTPUUserError, match="cache"):
+        m.unsync()
+    m._cache = cache
+    m.unsync()
+    np.testing.assert_allclose(float(m.state_dict()["x"]), 1.0)

@@ -1,0 +1,78 @@
+"""SSIM parity vs an independent numpy/scipy oracle.
+
+The reference validates SSIM against skimage (not shipped in this image), so
+the oracle here is a from-scratch numpy implementation of Wang et al.'s
+gaussian-weighted SSIM: separable gaussian window, local moments by VALID
+2-D convolution (mathematically identical to the library's reflect-pad +
+crop scheme on interior pixels), the standard (c1, c2) stabilized formula.
+"""
+import numpy as np
+import pytest
+from scipy.signal import convolve2d
+
+import jax.numpy as jnp
+
+from metrics_tpu import SSIM
+from metrics_tpu.functional import ssim
+
+def _np_gaussian_kernel(kernel_size, sigma):
+    def g1d(n, s):
+        x = np.arange(n, dtype=np.float64) - (n - 1) / 2
+        k = np.exp(-(x**2) / (2 * s * s))
+        return k / k.sum()
+
+    return np.outer(g1d(kernel_size[0], sigma[0]), g1d(kernel_size[1], sigma[1]))
+
+
+def _np_ssim(preds, target, data_range, kernel_size=(11, 11), sigma=(1.5, 1.5), k1=0.01, k2=0.03):
+    """Mean SSIM over [B, C, H, W] float images."""
+    kernel = _np_gaussian_kernel(kernel_size, sigma)
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    vals = []
+    for b in range(preds.shape[0]):
+        for c in range(preds.shape[1]):
+            x = preds[b, c].astype(np.float64)
+            y = target[b, c].astype(np.float64)
+            conv = lambda im: convolve2d(im, kernel, mode="valid")  # noqa: E731
+            mu_x, mu_y = conv(x), conv(y)
+            sigma_x = conv(x * x) - mu_x**2
+            sigma_y = conv(y * y) - mu_y**2
+            sigma_xy = conv(x * y) - mu_x * mu_y
+            num = (2 * mu_x * mu_y + c1) * (2 * sigma_xy + c2)
+            den = (mu_x**2 + mu_y**2 + c1) * (sigma_x + sigma_y + c2)
+            vals.append(num / den)
+    return np.mean(vals)
+
+
+@pytest.mark.parametrize("shape", [(2, 1, 24, 24), (1, 3, 32, 20)], ids=["gray", "rgb_rect"])
+@pytest.mark.parametrize("kernel_sigma", [((11, 11), (1.5, 1.5)), ((7, 5), (1.0, 2.0))], ids=["default", "asym"])
+def test_ssim_functional_vs_numpy(shape, kernel_sigma):
+    rng = np.random.RandomState(123)
+    kernel_size, sigma = kernel_sigma
+    preds = rng.rand(*shape).astype(np.float32)
+    target = np.clip(preds + rng.randn(*shape).astype(np.float32) * 0.1, 0, 1)
+    expected = _np_ssim(preds, target, data_range=1.0, kernel_size=kernel_size, sigma=sigma)
+    ours = float(ssim(jnp.asarray(preds), jnp.asarray(target),
+                      kernel_size=kernel_size, sigma=sigma, data_range=1.0))
+    np.testing.assert_allclose(ours, expected, atol=1e-5)
+
+
+def test_ssim_identical_images_is_one():
+    rng = np.random.RandomState(124)
+    x = rng.rand(1, 1, 16, 16).astype(np.float32)
+    np.testing.assert_allclose(float(ssim(jnp.asarray(x), jnp.asarray(x), data_range=1.0)), 1.0, atol=1e-6)
+
+
+def test_ssim_class_accumulation_vs_numpy():
+    # data_range given + mean reduction → the constant-memory streaming path
+    rng = np.random.RandomState(125)
+    m = SSIM(data_range=1.0)
+    batches = []
+    for _ in range(3):
+        p = rng.rand(2, 1, 24, 24).astype(np.float32)
+        t = np.clip(p + rng.randn(2, 1, 24, 24).astype(np.float32) * 0.05, 0, 1)
+        batches.append((p, t))
+        m.update(jnp.asarray(p), jnp.asarray(t))
+    expected = np.mean([_np_ssim(p, t, data_range=1.0) for p, t in batches])
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)

@@ -1,0 +1,88 @@
+"""ROUGE parity against the rouge_score package — the reference's oracle.
+
+Mirror of `tests/text/test_rouge.py`: every key × {precision, recall,
+fmeasure} × use_stemmer over the reference's example corpora, functional and
+class (accumulation + merge), against ``rouge_score.rouge_scorer`` with the
+reference's BootstrapAggregator mid value (the mid of per-sentence scores is
+the plain mean, matching our accumulation).
+"""
+import numpy as np
+import pytest
+
+rouge_score_pkg = pytest.importorskip(
+    "rouge_score", reason="rouge_score provides the ROUGE oracle (reference test_rouge.py does the same)"
+)
+from rouge_score.rouge_scorer import RougeScorer  # noqa: E402
+from rouge_score.scoring import BootstrapAggregator  # noqa: E402
+
+from metrics_tpu import ROUGEScore  # noqa: E402
+from metrics_tpu.functional import rouge_score  # noqa: E402
+
+ROUGE_KEYS = ("rouge1", "rouge2", "rougeL", "rougeLsum")
+
+BATCHES_1 = {
+    "preds": [["the cat was under the bed"], ["the cat was found under the bed"]],
+    "targets": [["the cat was found under the bed"], ["the tiny little cat was found under the big funny bed "]],
+}
+BATCHES_2 = {
+    "preds": [["The quick brown fox jumps over the lazy dog"], ["My name is John"]],
+    "targets": [["The quick brown dog jumps on the log."], ["Is your name John"]],
+}
+
+
+def _oracle(preds, targets, use_stemmer, rouge_level, metric):
+    scorer = RougeScorer(ROUGE_KEYS, use_stemmer=use_stemmer)
+    aggregator = BootstrapAggregator()
+    for pred, target in zip(preds, targets):
+        aggregator.add_scores(scorer.score(target, pred))
+    return getattr(aggregator.aggregate()[rouge_level].mid, metric)
+
+
+@pytest.mark.parametrize(
+    "key, use_stemmer",
+    [
+        ("rouge1_precision", True),
+        ("rouge1_recall", True),
+        ("rouge1_fmeasure", False),
+        ("rouge2_precision", False),
+        ("rouge2_recall", True),
+        ("rouge2_fmeasure", True),
+        ("rougeL_precision", False),
+        ("rougeL_recall", False),
+        ("rougeL_fmeasure", True),
+        ("rougeLsum_precision", True),
+        ("rougeLsum_recall", False),
+        ("rougeLsum_fmeasure", False),
+    ],
+)
+@pytest.mark.parametrize(
+    "preds_batches, target_batches",
+    [
+        (BATCHES_1["preds"], BATCHES_1["targets"]),
+        (BATCHES_2["preds"], BATCHES_2["targets"]),
+    ],
+    ids=["batches1", "batches2"],
+)
+class TestROUGEOracle:
+    def test_functional(self, preds_batches, target_batches, key, use_stemmer):
+        all_preds = [p for b in preds_batches for p in b]
+        all_targets = [t for b in target_batches for t in b]
+        rouge_level, metric = key.split("_")
+        expected = _oracle(all_preds, all_targets, use_stemmer, rouge_level, metric)
+        ours = rouge_score(all_preds, all_targets, use_stemmer=use_stemmer)
+        np.testing.assert_allclose(float(np.asarray(ours[key])), expected, atol=1e-6)
+
+    @pytest.mark.parametrize("world", [1, 2])
+    def test_class_accumulation(self, preds_batches, target_batches, key, use_stemmer, world):
+        metrics = [ROUGEScore(use_stemmer=use_stemmer) for _ in range(world)]
+        for i, (p, t) in enumerate(zip(preds_batches, target_batches)):
+            metrics[i % world].update(p, t)
+        merged = metrics[0]
+        for other in metrics[1:]:
+            merged.merge_state(other)
+        out = merged.compute()
+        all_preds = [p for b in preds_batches for p in b]
+        all_targets = [t for b in target_batches for t in b]
+        rouge_level, metric = key.split("_")
+        expected = _oracle(all_preds, all_targets, use_stemmer, rouge_level, metric)
+        np.testing.assert_allclose(float(np.asarray(out[key])), expected, atol=1e-6)
