@@ -1,0 +1,83 @@
+"""AverageMeter through the full tester grid (reference
+`tests/bases/test_average.py`): array/bool-weight/multi-dim values × ddp ×
+dist_sync_on_step, against np.average, plus default-weight and scalar-feed
+variants."""
+import numpy as np
+import pytest
+
+from metrics_tpu import AverageMeter
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+rng = np.random.RandomState(99)
+
+
+def _average(values, weights):
+    return np.average(np.ravel(values), weights=np.ravel(np.asarray(weights, np.float64)))
+
+
+def _average_ignore_weights(values, weights):
+    return np.average(np.ravel(values))
+
+
+class DefaultWeightWrapper(AverageMeter):
+    """Reference `test_average.py:13-17`: drop the weights, use the default."""
+
+    def update(self, values, weights):  # noqa: ARG002 - signature parity
+        super().update(values)
+
+
+class ScalarWrapper(AverageMeter):
+    """Reference `test_average.py:20-28`: feed scalars one at a time."""
+
+    def update(self, values, weights):
+        for v, w in zip(np.ravel(np.asarray(values)), np.ravel(np.asarray(weights))):
+            super().update(float(v), float(w))
+
+
+@pytest.mark.parametrize(
+    "values, weights",
+    [
+        (rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32), np.ones((NUM_BATCHES, BATCH_SIZE), np.float32)),
+        (rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+         (rng.rand(NUM_BATCHES, BATCH_SIZE) > 0.5).astype(np.float32)),
+        (rng.rand(NUM_BATCHES, BATCH_SIZE, 2).astype(np.float32),
+         (rng.rand(NUM_BATCHES, BATCH_SIZE, 2) > 0.5).astype(np.float32)),
+    ],
+    ids=["unit_weights", "bool_weights", "multidim_bool_weights"],
+)
+class TestAverageMeterMatrix(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_average_fn(self, ddp, dist_sync_on_step, values, weights):
+        self.run_class_metric_test(
+            ddp=ddp,
+            dist_sync_on_step=dist_sync_on_step,
+            metric_class=AverageMeter,
+            sk_metric=_average,
+            preds=values,      # tester names; AverageMeter sees (values, weights)
+            target=weights,
+            check_jit=False,   # jittability covered in tests/wrappers
+        )
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_average_fn_default_weights(self, ddp, values, weights):
+        self.run_class_metric_test(
+            ddp=ddp,
+            metric_class=DefaultWeightWrapper,
+            sk_metric=_average_ignore_weights,
+            preds=values,
+            target=weights,
+            check_jit=False,
+        )
+
+    def test_average_fn_scalar_feed(self, values, weights):
+        self.run_class_metric_test(
+            ddp=False,
+            metric_class=ScalarWrapper,
+            sk_metric=_average,
+            preds=values,
+            target=weights,
+            check_jit=False,
+        )

@@ -1,0 +1,254 @@
+"""Direct output tests of `_input_format_classification`.
+
+Port of the reference's `tests/classification/test_inputs.py`: the metric
+matrices validate metric-vs-sklearn where BOTH sides run inputs through the
+shared formatter, so a formatter bug would cancel out — these tests pin the
+formatter's outputs themselves against independently-constructed expectations
+(threshold/top-k/one-hot built inline in numpy), plus the full invalid-input
+and invalid-top_k ValueError grids.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.data import select_topk, to_onehot
+from metrics_tpu.utils.enums import DataType
+from tests.classification.inputs import (
+    Input,
+    _input_binary as _bin,
+    _input_binary_prob as _bin_prob,
+    _input_multiclass as _mc,
+    _input_multiclass_prob as _mc_prob,
+    _input_multidim_multiclass as _mdmc,
+    _input_multidim_multiclass_prob as _mdmc_prob,
+    _input_multilabel as _ml,
+    _input_multilabel_multidim as _mlmd,
+    _input_multilabel_multidim_prob as _mlmd_prob,
+    _input_multilabel_prob as _ml_prob,
+)
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES, THRESHOLD
+
+rng = np.random.RandomState(42)
+
+_ml_prob_half = Input(_ml_prob.preds.astype(np.float16), _ml_prob.target)
+
+__p = rng.rand(NUM_BATCHES, BATCH_SIZE, 2).astype(np.float32)
+_mc_prob_2cls = Input(__p / __p.sum(2, keepdims=True), rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)))
+
+__p = rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM, EXTRA_DIM).astype(np.float32)
+_mdmc_prob_many_dims = Input(
+    __p / __p.sum(2, keepdims=True),
+    rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM, EXTRA_DIM)),
+)
+
+__p = rng.rand(NUM_BATCHES, BATCH_SIZE, 2, EXTRA_DIM).astype(np.float32)
+_mdmc_prob_2cls = Input(__p / __p.sum(2, keepdims=True), rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)))
+
+
+# expectation builders (reference `test_inputs.py:58-120`), numpy/jnp flavors
+def _idn(x):
+    return jnp.asarray(x)
+
+
+def _usq(x):
+    return jnp.asarray(x)[..., None]
+
+
+def _thrs(x):
+    return jnp.asarray(x) >= THRESHOLD
+
+
+def _rshp1(x):
+    x = jnp.asarray(x)
+    return x.reshape(x.shape[0], -1)
+
+
+def _rshp2(x):
+    x = jnp.asarray(x)
+    return x.reshape(x.shape[0], x.shape[1], -1)
+
+
+def _onehot(x):
+    return to_onehot(jnp.asarray(x), NUM_CLASSES)
+
+
+def _onehot2(x):
+    return to_onehot(jnp.asarray(x), 2)
+
+
+def _top1(x):
+    return select_topk(jnp.asarray(x), 1)
+
+
+def _top2(x):
+    return select_topk(jnp.asarray(x), 2)
+
+
+def _ml_preds_tr(x):
+    return _rshp1(_thrs(x))
+
+
+def _onehot_rshp1(x):
+    return _onehot(_rshp1(x))
+
+
+def _onehot2_rshp1(x):
+    return _onehot2(_rshp1(x))
+
+
+def _top1_rshp2(x):
+    return _top1(_rshp2(x))
+
+
+def _top2_rshp2(x):
+    return _top2(_rshp2(x))
+
+
+def _probs_to_mc_preds_tr(x):
+    return _onehot2(_thrs(x))
+
+
+def _mlmd_prob_to_mc_preds_tr(x):
+    return _onehot2(_rshp1(_thrs(x)))
+
+
+@pytest.mark.parametrize(
+    "inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target",
+    [
+        (_bin, None, False, None, "multi-class", _usq, _usq),
+        (_bin, 1, False, None, "multi-class", _usq, _usq),
+        (_bin_prob, None, None, None, "binary", lambda x: _usq(_thrs(x)), _usq),
+        (_ml_prob, None, None, None, "multi-label", _thrs, _idn),
+        (_ml, None, False, None, "multi-dim multi-class", _idn, _idn),
+        (_ml_prob, None, None, 2, "multi-label", _top2, _rshp1),
+        (_mlmd, None, False, None, "multi-dim multi-class", _rshp1, _rshp1),
+        (_mc, NUM_CLASSES, None, None, "multi-class", _onehot, _onehot),
+        (_mc_prob, None, None, None, "multi-class", _top1, _onehot),
+        (_mc_prob, None, None, 2, "multi-class", _top2, _onehot),
+        (_mdmc, NUM_CLASSES, None, None, "multi-dim multi-class", _onehot, _onehot),
+        (_mdmc_prob, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot),
+        (_mdmc_prob, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot),
+        (_mdmc_prob_many_dims, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot_rshp1),
+        (_mdmc_prob_many_dims, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot_rshp1),
+        # half precision is promoted before thresholding
+        (_ml_prob_half, None, None, None, "multi-label", lambda x: _ml_preds_tr(np.asarray(x, np.float32)), _rshp1),
+        # binary as multiclass
+        (_bin, None, None, None, "multi-class", _onehot2, _onehot2),
+        # binary probs as multiclass
+        (_bin_prob, None, True, None, "binary", _probs_to_mc_preds_tr, _onehot2),
+        # multilabel as multiclass
+        (_ml, None, True, None, "multi-dim multi-class", _onehot2, _onehot2),
+        # multilabel probs as multiclass
+        (_ml_prob, None, True, None, "multi-label", _probs_to_mc_preds_tr, _onehot2),
+        # multidim multilabel as multiclass
+        (_mlmd, None, True, None, "multi-dim multi-class", _onehot2_rshp1, _onehot2_rshp1),
+        # multidim multilabel probs as multiclass
+        (_mlmd_prob, None, True, None, "multi-label", _mlmd_prob_to_mc_preds_tr, _onehot2_rshp1),
+        # multiclass prob with 2 classes as binary
+        (_mc_prob_2cls, None, False, None, "multi-class", lambda x: _top1(x)[:, [1]], _usq),
+        # multi-dim multi-class with 2 classes as multi-label
+        (_mdmc_prob_2cls, None, False, None, "multi-dim multi-class", lambda x: _top1(x)[:, 1], _idn),
+    ],
+)
+def test_usual_cases(inputs, num_classes, multiclass, top_k, exp_mode, post_preds, post_target):
+    """Formatted (preds, target, mode) equals independently-built expectations
+    (reference `test_inputs.py:126-201`), for a full batch and batch_size=1."""
+
+    def check(preds_in, target_in):
+        preds_out, target_out, mode = _input_format_classification(
+            preds=jnp.asarray(preds_in),
+            target=jnp.asarray(target_in),
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            multiclass=multiclass,
+            top_k=top_k,
+        )
+        assert mode == exp_mode
+        np.testing.assert_array_equal(
+            np.asarray(preds_out), np.asarray(post_preds(preds_in)).astype(np.int32)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(target_out), np.asarray(post_target(target_in)).astype(np.int32)
+        )
+
+    check(inputs.preds[0], inputs.target[0])
+    check(inputs.preds[0][[0]], inputs.target[0][[0]])
+
+
+def test_mode_string_and_enum_equivalence():
+    _, _, mode = _input_format_classification(
+        jnp.asarray(_bin_prob.preds[0]), jnp.asarray(_bin_prob.target[0]), threshold=THRESHOLD
+    )
+    assert mode == "binary" and mode == DataType.BINARY
+
+
+def test_threshold():
+    """>= threshold is inclusive (reference `test_inputs.py:205-211`)."""
+    target = jnp.asarray([1, 1, 1])
+    preds_probs = jnp.asarray([0.5 - 1e-5, 0.5, 0.5 + 1e-5])
+    preds_out, _, _ = _input_format_classification(preds_probs, target, threshold=0.5)
+    np.testing.assert_array_equal(np.asarray(preds_out).squeeze(), [0, 1, 1])
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass",
+    [
+        (rng.randint(0, 2, (7,)), rng.randint(0, 2, (7,)).astype(np.float32), None, None),
+        (rng.randint(0, 2, (7,)), -rng.randint(0, 2, (7,)) - 1, None, None),
+        (-rng.randint(1, 3, (7,)), rng.randint(0, 2, (7,)), None, None),
+        (rng.rand(7).astype(np.float32), rng.randint(2, 4, (7,)), None, False),
+        (rng.randint(2, 4, (7,)), rng.randint(0, 2, (7,)), None, False),
+        (rng.randint(0, 2, (8,)), rng.randint(0, 2, (7,)), None, None),
+        (rng.randint(0, 2, (7,)), rng.randint(0, 2, (7, 4)), None, None),
+        (rng.randint(0, 2, (7, 3)), rng.randint(0, 2, (7, 4)), None, None),
+        (rng.rand(7, 3).astype(np.float32), rng.randint(2, 4, (7, 3)), None, None),
+        (rng.rand(7, 3, 4, 3).astype(np.float32), rng.randint(0, 4, (7, 3, 3)), None, None),
+        (rng.randint(0, 2, (7, 3, 3, 4)), rng.randint(0, 4, (7, 3, 3)), None, None),
+        (_mc_prob.preds[0], rng.randint(0, 2, (BATCH_SIZE,)), None, False),
+        (_mc_prob.preds[0], rng.randint(NUM_CLASSES + 1, 100, (BATCH_SIZE,)), None, None),
+        (_mc_prob.preds[0], _mc_prob.target[0], NUM_CLASSES + 1, None),
+        (_mc_prob.preds[0], rng.randint(NUM_CLASSES + 1, 100, (BATCH_SIZE, NUM_CLASSES)), 4, None),
+        (rng.randint(0, 4, (7, 3)), rng.randint(5, 7, (7, 3)), 4, None),
+        (rng.randint(0, 2, (7,)), rng.randint(0, 2, (7,)), 1, None),
+        (rng.randint(0, 2, (7, 3, 3)), rng.randint(0, 2, (7, 3, 3)), 4, False),
+        (rng.rand(7, 3, 3).astype(np.float32), rng.randint(0, 2, (7, 3, 3)), 4, False),
+        (rng.rand(7, 3).astype(np.float32), rng.randint(0, 2, (7, 3)), 4, True),
+        (rng.rand(7).astype(np.float32), rng.randint(0, 2, (7,)), 4, None),
+        (rng.rand(7).astype(np.float32), rng.randint(0, 2, (7,)), 2, None),
+        (rng.rand(7).astype(np.float32), rng.randint(0, 2, (7,)), 2, False),
+        (rng.rand(7).astype(np.float32), rng.randint(0, 2, (7,)), 1, True),
+    ],
+)
+def test_incorrect_inputs(preds, target, num_classes, multiclass):
+    """The reference's full invalid-input grid (`test_inputs.py:219-276`)."""
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=jnp.asarray(preds), target=jnp.asarray(target),
+            threshold=THRESHOLD, num_classes=num_classes, multiclass=multiclass,
+        )
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, multiclass, top_k",
+    [
+        (_bin.preds[0], _bin.target[0], None, None, 2),
+        (_bin_prob.preds[0], _bin_prob.target[0], None, None, 2),
+        (_mc.preds[0], _mc.target[0], None, None, 2),
+        (_ml.preds[0], _ml.target[0], None, None, 2),
+        (_mlmd.preds[0], _mlmd.target[0], None, None, 2),
+        (_mdmc.preds[0], _mdmc.target[0], None, None, 2),
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, None, 0),
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, False, 2),
+        (_mc_prob.preds[0], _mc_prob.target[0], None, None, NUM_CLASSES),
+        (_ml_prob.preds[0], _ml_prob.target[0], None, True, 2),
+        (_ml_prob.preds[0], _ml_prob.target[0], None, True, NUM_CLASSES),
+    ],
+)
+def test_incorrect_inputs_topk(preds, target, num_classes, multiclass, top_k):
+    """Invalid top_k combinations raise (`test_inputs.py:279-312`)."""
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=jnp.asarray(preds), target=jnp.asarray(target), threshold=THRESHOLD,
+            num_classes=num_classes, multiclass=multiclass, top_k=top_k,
+        )
