@@ -1,0 +1,33 @@
+.PHONY: test test-fast doctest docs bench perf-smoke clean
+
+# Dev workflow targets (analogue of the reference's Makefile:1-28, minus the
+# network-dependent env/pip steps — this image is zero-egress).
+
+clean:
+	rm -rf .pytest_cache
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +
+
+# full suite on the 8-device virtual CPU mesh (conftest pins the platform)
+test:
+	python -m pytest tests/ -q -rs
+
+# skip the slow marks (BERT jit, subprocess DDP, real-weight parity)
+test-fast:
+	python -m pytest tests/ -q -m "not slow"
+
+# docstring examples across the package (also part of `make test` via
+# tests/test_doctests.py)
+doctest:
+	python -m pytest --doctest-modules metrics_tpu -q
+
+# regenerate the per-metric API pages (gated by tests/utils/test_docs_reference.py)
+docs:
+	python docs/generate_reference.py
+
+# benchmark contract line (TPU when the tunnel is alive, CPU fallback otherwise);
+# `--all` additionally runs configs 2-7
+bench:
+	python bench.py
+
+perf-smoke:
+	python -m pytest -m perf -q

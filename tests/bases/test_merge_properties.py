@@ -1,0 +1,100 @@
+"""Algebraic properties of merge_state across metric families.
+
+The merge-based forward (`core/metric.py:261-304`) replaces the reference's
+double-update with `merged = merge_states(accumulated, batch)` — that is only
+sound if merging is associative and agrees with plain accumulation over the
+concatenated data. Pin both properties for one metric per state algebra:
+sum (Accuracy), running moments with pairwise merge (PearsonCorrcoef),
+cat-list (SpearmanCorrcoef), CatBuffer (AUROC.with_capacity), min/max (PSNR),
+and dict-of-counters (ROUGEScore).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import AUROC, Accuracy, PSNR, PearsonCorrcoef, ROUGEScore, SpearmanCorrcoef
+
+rng = np.random.RandomState(31)
+
+
+def _chunks(n):
+    out = []
+    for _ in range(n):
+        preds = rng.rand(64).astype(np.float32)
+        target = rng.randint(0, 2, 64)
+        target[0], target[1] = 0, 1  # both classes for AUROC
+        out.append((preds, target))
+    return out
+
+
+CASES = {
+    "accuracy_sum": (lambda: Accuracy(), _chunks(3)),
+    "pearson_moments": (
+        lambda: PearsonCorrcoef(),
+        [(rng.rand(64).astype(np.float32), rng.rand(64).astype(np.float32)) for _ in range(3)],
+    ),
+    "spearman_catlist": (
+        lambda: SpearmanCorrcoef(),
+        [(rng.rand(64).astype(np.float32), rng.rand(64).astype(np.float32)) for _ in range(3)],
+    ),
+    "auroc_catbuffer": (lambda: AUROC().with_capacity(1024), _chunks(3)),
+    "psnr_minmax": (
+        lambda: PSNR(),
+        [((rng.rand(64) * 3).astype(np.float32), (rng.rand(64) * 3).astype(np.float32)) for _ in range(3)],
+    ),
+    "rouge_counterdict": (
+        lambda: ROUGEScore(),
+        [(["the cat sat on the mat"], ["a cat sat there"]),
+         (["tiny dog barks"], ["a tiny dog barked loudly"]),
+         (["metrics on tpus"], ["metrics running on tpus"])],
+    ),
+}
+
+
+def _leaf_close(a, b, atol=1e-6):
+    import jax
+
+    la = [np.asarray(jnp.asarray(x, jnp.float32), np.float64) for x in jax.tree_util.tree_leaves(a)]
+    lb = [np.asarray(jnp.asarray(x, jnp.float32), np.float64) for x in jax.tree_util.tree_leaves(b)]
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(x, y, atol=atol, rtol=1e-5)
+
+
+def _metric_with(make, chunks):
+    m = make()
+    for args in chunks:
+        m.update(*(jnp.asarray(a) if not isinstance(a, list) else a for a in args))
+    return m
+
+
+@pytest.mark.parametrize("name", sorted(CASES), ids=sorted(CASES))
+def test_merge_agrees_with_plain_accumulation(name):
+    """compute(merge(A, B, C)) == compute(single metric fed all chunks)."""
+    make, chunks = CASES[name]
+    parts = [_metric_with(make, [c]) for c in chunks]
+    merged = parts[0]
+    for p in parts[1:]:
+        merged.merge_state(p)
+    whole = _metric_with(make, chunks)
+    _leaf_close(merged.compute(), whole.compute(), atol=1e-5)
+
+
+@pytest.mark.parametrize("name", sorted(CASES), ids=sorted(CASES))
+def test_merge_is_associative(name):
+    """(A ⊕ B) ⊕ C == A ⊕ (B ⊕ C) at the compute level."""
+    make, chunks = CASES[name]
+
+    def build(i):
+        return _metric_with(make, [chunks[i]])
+
+    left = build(0)
+    left.merge_state(build(1))
+    left.merge_state(build(2))
+
+    right_tail = build(1)
+    right_tail.merge_state(build(2))
+    right = build(0)
+    right.merge_state(right_tail)
+
+    _leaf_close(left.compute(), right.compute(), atol=1e-5)
