@@ -1,0 +1,182 @@
+"""AUROC / AveragePrecision input-type × average (× max_fpr) matrices.
+
+Mirror of the reference's `tests/classification/test_auroc.py` and
+`test_average_precision.py`: binary / multiclass / mdmc / multilabel /
+multilabel-multidim probability fixtures, average ∈ {macro, weighted, micro},
+max_fpr ∈ {None, 0.8, 0.5} (binary only, McClish correction), against
+sklearn's roc_auc_score / average_precision_score, through class
+(eager + ddp + per-step sync) and functional paths.
+"""
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_average_precision
+from sklearn.metrics import roc_auc_score as sk_roc_auc_score
+
+from metrics_tpu import AUROC, AveragePrecision
+from metrics_tpu.functional import auroc, average_precision
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass_prob as _input_mcls_prob,
+    _input_multidim_multiclass_prob as _input_mdmc_prob,
+    _input_multilabel_multidim_prob as _input_mlmd_prob,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+
+# -- sk wrappers (reference test_auroc.py:34-87) ----------------------------
+def _sk_auroc_binary(preds, target, num_classes, average="macro", max_fpr=None):
+    return sk_roc_auc_score(target.reshape(-1), preds.reshape(-1), average=average, max_fpr=max_fpr)
+
+
+def _sk_auroc_multiclass(preds, target, num_classes, average="macro", max_fpr=None):
+    return sk_roc_auc_score(
+        target.reshape(-1), preds.reshape(-1, num_classes), average=average, max_fpr=max_fpr, multi_class="ovr"
+    )
+
+
+def _sk_auroc_mdmc(preds, target, num_classes, average="macro", max_fpr=None):
+    p = np.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+    return sk_roc_auc_score(target.reshape(-1), p, average=average, max_fpr=max_fpr, multi_class="ovr")
+
+
+def _sk_auroc_multilabel(preds, target, num_classes, average="macro", max_fpr=None):
+    return sk_roc_auc_score(
+        target.reshape(-1, num_classes), preds.reshape(-1, num_classes), average=average, max_fpr=max_fpr
+    )
+
+
+def _sk_auroc_mlmd(preds, target, num_classes, average="macro", max_fpr=None):
+    p = np.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+    t = np.moveaxis(target, 1, -1).reshape(-1, num_classes)
+    return sk_roc_auc_score(t, p, average=average, max_fpr=max_fpr)
+
+
+def _sk_ap_binary(preds, target, num_classes):
+    return sk_average_precision(target.reshape(-1), preds.reshape(-1))
+
+
+def _sk_ap_multiclass(preds, target, num_classes):
+    p = preds.reshape(-1, num_classes)
+    t = target.reshape(-1)
+    return np.mean([sk_average_precision((t == c).astype(int), p[:, c]) for c in range(num_classes)])
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", "micro"])
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_auroc_binary, 1),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, _sk_auroc_multiclass, NUM_CLASSES),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, _sk_auroc_mdmc, NUM_CLASSES),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, _sk_auroc_multilabel, NUM_CLASSES),
+        (_input_mlmd_prob.preds, _input_mlmd_prob.target, _sk_auroc_mlmd, NUM_CLASSES),
+    ],
+    ids=["binary", "multiclass", "mdmc", "multilabel", "mlmd"],
+)
+class TestAUROCMatrix(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_auroc_class(self, preds, target, sk_metric, num_classes, average, ddp, dist_sync_on_step):
+        if average == "micro" and preds.ndim > 2 and preds.ndim == target.ndim + 1:
+            pytest.skip("micro average is undefined for multiclass AUROC")
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=AUROC,
+            sk_metric=partial(sk_metric, num_classes=num_classes, average=average, max_fpr=None),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={
+                "num_classes": None if num_classes == 1 else num_classes,
+                "average": average,
+            },
+            check_batch=False,  # rank-based: per-batch value differs from accumulated
+            check_jit=False,
+        )
+
+    def test_auroc_fn(self, preds, target, sk_metric, num_classes, average):
+        if average == "micro" and preds.ndim > 2 and preds.ndim == target.ndim + 1:
+            pytest.skip("micro average is undefined for multiclass AUROC")
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=auroc,
+            sk_metric=partial(sk_metric, num_classes=num_classes, average=average, max_fpr=None),
+            metric_args={
+                "num_classes": None if num_classes == 1 else num_classes,
+                "average": average,
+            },
+        )
+
+
+@pytest.mark.parametrize("max_fpr", [0.8, 0.5])
+@pytest.mark.parametrize("ddp", [True, False])
+def test_auroc_binary_max_fpr(max_fpr, ddp):
+    """McClish-corrected partial AUROC is a binary-only argument, so it gets
+    its own binary grid instead of 4/5 skipped fixture rows."""
+
+    class _T(MetricTester):
+        atol = 1e-6
+
+    _T().run_class_metric_test(
+        ddp=ddp,
+        preds=_input_binary_prob.preds,
+        target=_input_binary_prob.target,
+        metric_class=AUROC,
+        sk_metric=partial(_sk_auroc_binary, num_classes=1, average="macro", max_fpr=max_fpr),
+        metric_args={"max_fpr": max_fpr},
+        check_batch=False,
+        check_jit=False,
+    )
+
+
+def test_auroc_wrong_max_fpr():
+    """Invalid max_fpr values raise (reference `test_auroc.py:141-151`)."""
+    import jax.numpy as jnp
+
+    for bad in (-0.5, 0.0, 1.5, "x"):
+        with pytest.raises(ValueError):
+            auroc(jnp.asarray(_input_binary_prob.preds[0]), jnp.asarray(_input_binary_prob.target[0]), max_fpr=bad)
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_ap_binary, 1),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, _sk_ap_multiclass, NUM_CLASSES),
+    ],
+    ids=["binary", "multiclass"],
+)
+class TestAveragePrecisionMatrix(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_ap_class(self, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=AveragePrecision,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": None if num_classes == 1 else num_classes},
+            check_batch=False,
+            check_jit=False,
+        )
+
+    def test_ap_fn(self, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=average_precision,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            metric_args={"num_classes": None if num_classes == 1 else num_classes},
+        )

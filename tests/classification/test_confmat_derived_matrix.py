@@ -1,0 +1,189 @@
+"""IoU / CohenKappa / MatthewsCorrcoef input-type matrices vs sklearn.
+
+Mirror of the reference's `tests/classification/test_iou.py`,
+`test_cohen_kappa.py`, and `test_matthews_corrcoef.py`: binary / prob /
+multilabel / multiclass / mdmc fixtures through class (eager + ddp +
+per-step sync) and functional paths against jaccard_score /
+cohen_kappa_score / matthews_corrcoef, plus IoU's hand-worked
+ignore_index / absent_score edge tables.
+"""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import cohen_kappa_score as sk_cohen_kappa
+from sklearn.metrics import jaccard_score as sk_jaccard
+from sklearn.metrics import matthews_corrcoef as sk_matthews
+
+from metrics_tpu import CohenKappa, IoU, MatthewsCorrcoef
+from metrics_tpu.functional import cohen_kappa, iou, matthews_corrcoef
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_prob as _input_mcls_prob,
+    _input_multidim_multiclass as _input_mdmc,
+    _input_multilabel as _input_mlb,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _flat_labels(preds, target, num_classes):
+    """Collapse any accepted input pair to flat label vectors (argmax probs /
+    threshold binaries), mirroring the reference's per-case sk wrappers."""
+    p, t = np.asarray(preds), np.asarray(target)
+    if p.dtype.kind == "f":
+        if p.ndim == t.ndim + 1:  # class dim present → argmax
+            p = np.argmax(p, axis=1)
+        else:  # probabilities → threshold
+            p = (p >= THRESHOLD).astype(int)
+    return p.reshape(-1), t.reshape(-1)
+
+
+def _sk_iou(preds, target, num_classes, average="macro"):
+    p, t = _flat_labels(preds, target, num_classes)
+    return sk_jaccard(t, p, average=average, labels=list(range(num_classes)))
+
+
+def _sk_kappa(preds, target, num_classes, weights=None):
+    p, t = _flat_labels(preds, target, num_classes)
+    return sk_cohen_kappa(y1=t, y2=p, weights=weights)
+
+
+def _sk_mcc(preds, target, num_classes):
+    p, t = _flat_labels(preds, target, num_classes)
+    return sk_matthews(t, p)
+
+
+_GRID = [
+    (_input_binary_prob.preds, _input_binary_prob.target, 2),
+    (_input_binary.preds, _input_binary.target, 2),
+    (_input_mlb_prob.preds, _input_mlb_prob.target, 2),
+    (_input_mlb.preds, _input_mlb.target, 2),
+    (_input_mcls_prob.preds, _input_mcls_prob.target, NUM_CLASSES),
+    (_input_multiclass.preds, _input_multiclass.target, NUM_CLASSES),
+    (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES),
+]
+_GRID_IDS = ["binary_prob", "binary", "multilabel_prob", "multilabel", "mcls_prob", "mcls", "mdmc"]
+
+
+@pytest.mark.parametrize("preds, target, num_classes", _GRID, ids=_GRID_IDS)
+class TestConfmatDerivedMatrix(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_iou_class(self, preds, target, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=IoU,
+            sk_metric=partial(_sk_iou, num_classes=num_classes),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+            check_jit=False,
+        )
+
+    def test_iou_fn(self, preds, target, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=iou,
+            sk_metric=partial(_sk_iou, num_classes=num_classes),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+        )
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    @pytest.mark.parametrize("ddp", [True, False])
+    def test_kappa_class(self, preds, target, num_classes, weights, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=CohenKappa,
+            sk_metric=partial(_sk_kappa, num_classes=num_classes, weights=weights),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD, "weights": weights},
+            check_jit=False,
+        )
+
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_kappa_fn(self, preds, target, num_classes, weights):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=cohen_kappa,
+            sk_metric=partial(_sk_kappa, num_classes=num_classes, weights=weights),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD, "weights": weights},
+        )
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_mcc_class(self, preds, target, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=MatthewsCorrcoef,
+            sk_metric=partial(_sk_mcc, num_classes=num_classes),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+            check_jit=False,
+        )
+
+    def test_mcc_fn(self, preds, target, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=matthews_corrcoef,
+            sk_metric=partial(_sk_mcc, num_classes=num_classes),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD},
+        )
+
+
+@pytest.mark.parametrize(
+    "pred, target, ignore_index, absent_score, num_classes, expected",
+    [
+        # the reference's absent_score table (`test_iou.py:165-198`)
+        ([0], [0], None, -1.0, 2, [1.0, -1.0]),
+        ([0, 0], [0, 0], None, -1.0, 2, [1.0, -1.0]),
+        ([0], [0], None, -1.0, 1, [1.0]),
+        ([1], [1], None, -1.0, 2, [-1.0, 1.0]),
+        ([1], [1], 0, -1.0, 2, [1.0]),
+        ([0, 2], [0, 2], None, -1.0, 3, [1.0, -1.0, 1.0]),
+        ([0, 1], [0, 1], None, -1.0, 3, [1.0, 1.0, -1.0]),
+        ([0, 1], [0, 0], None, -1.0, 3, [0.5, 0.0, -1.0]),
+        ([0, 0], [0, 1], None, -1.0, 3, [0.5, 0.0, -1.0]),
+        ([0, 2], [0, 2], None, 1.0, 3, [1.0, 1.0, 1.0]),
+        ([0, 2], [0, 2], 0, 1.0, 3, [1.0, 1.0]),
+    ],
+)
+def test_iou_absent_score(pred, target, ignore_index, absent_score, num_classes, expected):
+    out = iou(
+        jnp.asarray(pred), jnp.asarray(target),
+        ignore_index=ignore_index, absent_score=absent_score,
+        num_classes=num_classes, reduction="none",
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(expected), atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "pred, target, ignore_index, num_classes, reduction, expected",
+    [
+        # the reference's ignore_index table (`test_iou.py:211-226`)
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], None, 3, "none", [1, 1 / 2, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 0, 3, "none", [1 / 2, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 1, 3, "none", [1, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 2, 3, "none", [1, 1]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 0, 3, "elementwise_mean", [7 / 12]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 0, 3, "sum", [7 / 6]),
+    ],
+)
+def test_iou_ignore_index(pred, target, ignore_index, num_classes, reduction, expected):
+    out = iou(
+        jnp.asarray(pred), jnp.asarray(target),
+        ignore_index=ignore_index, num_classes=num_classes, reduction=reduction,
+    )
+    np.testing.assert_allclose(np.asarray(out).reshape(-1), np.asarray(expected), atol=1e-6)

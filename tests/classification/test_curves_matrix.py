@@ -1,0 +1,112 @@
+"""ROC / PrecisionRecallCurve class-path matrices over every prob fixture.
+
+Complement to `test_curves.py` (single-batch functional parity): here the
+CLASS metrics accumulate all NUM_BATCHES batches (cat-list states), optionally
+across two simulated ranks merged with `merge_state`, and the resulting
+curves are compared point-for-point with sklearn on the concatenated data —
+mirror of the reference's `test_roc.py` / `test_precision_recall_curve.py`
+grids (binary / multiclass / mdmc / multilabel / mlmd).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_recall_curve as sk_precision_recall_curve
+from sklearn.metrics import roc_curve as sk_roc_curve
+
+from metrics_tpu import ROC, PrecisionRecallCurve
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_multiclass_prob as _input_mcls_prob,
+    _input_multidim_multiclass_prob as _input_mdmc_prob,
+    _input_multilabel_multidim_prob as _input_mlmd_prob,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import NUM_BATCHES, NUM_CLASSES
+
+# (fixture, num_classes, flavor); flavor decides how sklearn per-class truth
+# is built from the concatenated raw data
+_GRID = [
+    (_input_binary_prob, 1, "binary"),
+    (_input_mcls_prob, NUM_CLASSES, "multiclass"),
+    (_input_mdmc_prob, NUM_CLASSES, "mdmc"),
+    (_input_mlb_prob, NUM_CLASSES, "multilabel"),
+    (_input_mlmd_prob, NUM_CLASSES, "mlmd"),
+]
+_IDS = [g[2] for g in _GRID]
+
+
+def _flatten(inputs, flavor, num_classes):
+    """Concatenate all batches and collapse to (scores[N, C] or [N], labels)."""
+    preds = np.concatenate(list(inputs.preds), axis=0)
+    target = np.concatenate(list(inputs.target), axis=0)
+    if flavor == "binary":
+        return preds.reshape(-1), target.reshape(-1)
+    if flavor == "multiclass":
+        return preds.reshape(-1, num_classes), target.reshape(-1)
+    if flavor == "mdmc":
+        return np.moveaxis(preds, 1, -1).reshape(-1, num_classes), target.reshape(-1)
+    if flavor == "multilabel":
+        return preds.reshape(-1, num_classes), target.reshape(-1, num_classes)
+    if flavor == "mlmd":
+        return (
+            np.moveaxis(preds, 1, -1).reshape(-1, num_classes),
+            np.moveaxis(target, 1, -1).reshape(-1, num_classes),
+        )
+    raise ValueError(flavor)
+
+
+def _class_truth(scores, labels, flavor, c):
+    if flavor in ("multilabel", "mlmd"):
+        return labels[:, c], scores[:, c]
+    return (labels == c).astype(int), scores[:, c]
+
+
+def _accumulate(metric_cls, inputs, num_classes, world):
+    kwargs = {} if num_classes == 1 else {"num_classes": num_classes}
+    metrics = [metric_cls(**kwargs) for _ in range(world)]
+    for i in range(NUM_BATCHES):
+        metrics[i % world].update(jnp.asarray(inputs.preds[i]), jnp.asarray(inputs.target[i]))
+    merged = metrics[0]
+    for m in metrics[1:]:
+        merged.merge_state(m)
+    return merged.compute()
+
+
+@pytest.mark.parametrize("inputs, num_classes, flavor", _GRID, ids=_IDS)
+@pytest.mark.parametrize("world", [1, 2], ids=["single", "ddp_merge"])
+def test_roc_class_matrix(inputs, num_classes, flavor, world):
+    fpr, tpr, _ = _accumulate(ROC, inputs, num_classes, world)
+    scores, labels = _flatten(inputs, flavor, num_classes)
+    if flavor == "binary":
+        sk_fpr, sk_tpr, _ = sk_roc_curve(labels, scores, drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+        return
+    for c in range(num_classes):
+        t, s = _class_truth(scores, labels, flavor, c)
+        sk_fpr, sk_tpr, _ = sk_roc_curve(t, s, drop_intermediate=False)
+        np.testing.assert_allclose(np.asarray(fpr[c]), sk_fpr, atol=1e-6, err_msg=f"class {c} fpr")
+        np.testing.assert_allclose(np.asarray(tpr[c]), sk_tpr, atol=1e-6, err_msg=f"class {c} tpr")
+
+
+@pytest.mark.parametrize("inputs, num_classes, flavor", _GRID, ids=_IDS)
+@pytest.mark.parametrize("world", [1, 2], ids=["single", "ddp_merge"])
+def test_prc_class_matrix(inputs, num_classes, flavor, world):
+    precision, recall, _ = _accumulate(PrecisionRecallCurve, inputs, num_classes, world)
+    scores, labels = _flatten(inputs, flavor, num_classes)
+
+    def check(ours_p, ours_r, t, s, msg):
+        sk_p, sk_r, _ = sk_precision_recall_curve(t, s)
+        # the reference truncates the full-recall plateau to its last point;
+        # sklearn keeps the plateau, so our curve equals sklearn's tail
+        off = len(sk_p) - len(np.asarray(ours_p))
+        assert off >= 0, f"{msg}: curve longer than sklearn's ({len(np.asarray(ours_p))} vs {len(sk_p)})"
+        np.testing.assert_allclose(np.asarray(ours_p), sk_p[off:], atol=1e-6, err_msg=msg)
+        np.testing.assert_allclose(np.asarray(ours_r), sk_r[off:], atol=1e-6, err_msg=msg)
+
+    if flavor == "binary":
+        check(precision, recall, labels, scores, "binary")
+        return
+    for c in range(num_classes):
+        t, s = _class_truth(scores, labels, flavor, c)
+        check(precision[c], recall[c], t, s, f"class {c}")
