@@ -14,11 +14,8 @@ from metrics_tpu.utils.checks import _input_format_classification
 def _hamming_distance_update(
     preds: Array, target: Array, threshold: float = 0.5
 ) -> Tuple[Array, int]:
-    # the reference documents (but never enforces) this contract
-    # (``classification/hamming_distance.py:59``); enforce it here so a typo'd
-    # threshold fails loudly instead of silently zeroing every prediction
-    if not 0 < threshold < 1:
-        raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
+    # probability-aware threshold validation happens in the shared formatter
+    # (utils/checks.py::_check_classification_inputs)
     preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
     correct = jnp.sum(preds == target)
     total = preds.size

