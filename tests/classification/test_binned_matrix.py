@@ -1,0 +1,94 @@
+"""Binned PR-family matrices vs sklearn curve oracles.
+
+Mirror of the reference's `tests/classification/test_binned_precision_recall.py`:
+BinnedRecallAtFixedPrecision over binary / plausible / multilabel fixtures ×
+min_precision sweep (inputs rounded to 2 decimals so 101 bins capture the
+curve exactly), and BinnedAveragePrecision vs sklearn's continuous AP, all
+through class accumulation (single + 2-rank merge).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_average_precision
+from sklearn.metrics import precision_recall_curve as sk_precision_recall_curve
+
+from metrics_tpu import BinnedAveragePrecision, BinnedRecallAtFixedPrecision
+from tests.classification.inputs import (
+    _input_binary_prob,
+    _input_binary_prob_plausible as _input_binary_prob_ok,
+    _input_multilabel_prob as _input_mlb_prob,
+    _input_multilabel_prob_plausible as _input_mlb_prob_ok,
+)
+from tests.helpers.testers import NUM_CLASSES, accumulate_and_merge
+
+
+def _recall_at_precision(predictions, targets, min_precision):
+    """Reference `test_binned_precision_recall.py:37-47`."""
+    precision, recall, thresholds = sk_precision_recall_curve(targets, predictions)
+    tuple_all = [(r, p, t) for p, r, t in zip(precision, recall, thresholds) if p >= min_precision]
+    if not tuple_all:
+        return 0.0, 1e6
+    max_recall, _, best_threshold = max(tuple_all)
+    return float(max_recall), float(best_threshold)
+
+
+_GRID = [
+    (_input_binary_prob, 1, "binary"),
+    (_input_binary_prob_ok, 1, "binary_plausible"),
+    (_input_mlb_prob_ok, NUM_CLASSES, "multilabel_plausible"),
+    (_input_mlb_prob, NUM_CLASSES, "multilabel"),
+]
+_IDS = [g[2] for g in _GRID]
+
+
+@pytest.mark.parametrize("inputs, num_classes, _name", _GRID, ids=_IDS)
+@pytest.mark.parametrize("min_precision", [0.05, 0.1, 0.3, 0.5, 0.8, 0.95])
+@pytest.mark.parametrize("world", [1, 2], ids=["single", "ddp_merge"])
+def test_binned_recall_at_fixed_precision(inputs, num_classes, _name, min_precision, world):
+    # rounding to 2 decimals makes the 101-threshold binning exact for both
+    preds = np.round(np.asarray(inputs.preds), 2) + 1e-6
+    target = np.asarray(inputs.target)
+
+    recalls, thresholds = accumulate_and_merge(
+        lambda: BinnedRecallAtFixedPrecision(
+            num_classes=num_classes, min_precision=min_precision, thresholds=101
+        ),
+        preds, target, world,
+    )
+    p_all = preds.reshape(-1, num_classes) if num_classes > 1 else preds.reshape(-1)
+    t_all = target.reshape(-1, num_classes) if num_classes > 1 else target.reshape(-1)
+    def check(ours_r, ours_t, exp_r, exp_t, msg):
+        np.testing.assert_allclose(ours_r, exp_r, atol=0.02, err_msg=msg)
+        # thresholds agree within one bin width (or both hit the no-bin
+        # sentinel, 1e6)
+        if exp_t >= 1e6 or ours_t >= 1e6:
+            assert exp_t >= 1e6 and ours_t >= 1e6, f"{msg}: sentinel mismatch ({ours_t} vs {exp_t})"
+        else:
+            np.testing.assert_allclose(ours_t, exp_t, atol=0.02, err_msg=msg)
+
+    if num_classes == 1:
+        exp_r, exp_t = _recall_at_precision(p_all, t_all, min_precision)
+        check(float(jnp.ravel(jnp.asarray(recalls))[0]), float(jnp.ravel(jnp.asarray(thresholds))[0]),
+              exp_r, exp_t, "binary")
+    else:
+        for c in range(num_classes):
+            exp_r, exp_t = _recall_at_precision(p_all[:, c], t_all[:, c], min_precision)
+            check(float(np.asarray(recalls)[c]), float(np.asarray(thresholds)[c]), exp_r, exp_t, f"class {c}")
+
+
+@pytest.mark.parametrize("inputs, num_classes, _name", _GRID, ids=_IDS)
+@pytest.mark.parametrize("world", [1, 2], ids=["single", "ddp_merge"])
+def test_binned_average_precision(inputs, num_classes, _name, world):
+    preds = np.round(np.asarray(inputs.preds), 2) + 1e-6
+    target = np.asarray(inputs.target)
+
+    result = accumulate_and_merge(
+        lambda: BinnedAveragePrecision(num_classes=num_classes, thresholds=101),
+        preds, target, world,
+    )
+    p_all = preds.reshape(-1, num_classes) if num_classes > 1 else preds.reshape(-1)
+    t_all = target.reshape(-1, num_classes) if num_classes > 1 else target.reshape(-1)
+    expected = np.nan_to_num(sk_average_precision(t_all, p_all, average=None))
+    np.testing.assert_allclose(
+        np.ravel(np.asarray(jnp.asarray(result))), np.ravel(np.atleast_1d(expected)), atol=0.02
+    )

@@ -21,7 +21,7 @@ from tests.classification.inputs import (
     _input_multilabel_multidim_prob as _input_mlmd_prob,
     _input_multilabel_prob as _input_mlb_prob,
 )
-from tests.helpers.testers import NUM_BATCHES, NUM_CLASSES
+from tests.helpers.testers import NUM_CLASSES, accumulate_and_merge
 
 # (fixture, num_classes, flavor); flavor decides how sklearn per-class truth
 # is built from the concatenated raw data
@@ -63,13 +63,7 @@ def _class_truth(scores, labels, flavor, c):
 
 def _accumulate(metric_cls, inputs, num_classes, world):
     kwargs = {} if num_classes == 1 else {"num_classes": num_classes}
-    metrics = [metric_cls(**kwargs) for _ in range(world)]
-    for i in range(NUM_BATCHES):
-        metrics[i % world].update(jnp.asarray(inputs.preds[i]), jnp.asarray(inputs.target[i]))
-    merged = metrics[0]
-    for m in metrics[1:]:
-        merged.merge_state(m)
-    return merged.compute()
+    return accumulate_and_merge(lambda: metric_cls(**kwargs), inputs.preds, inputs.target, world)
 
 
 @pytest.mark.parametrize("inputs, num_classes, flavor", _GRID, ids=_IDS)

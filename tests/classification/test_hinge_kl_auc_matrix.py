@@ -1,0 +1,202 @@
+"""Hinge / KLDivergence / AUC grids vs sklearn & scipy.
+
+Mirror of the reference's `tests/classification/test_hinge.py`,
+`test_kl_divergence.py`, and `test_auc.py`: hinge over binary / single-elem /
+multiclass × squared × multiclass_mode against an sklearn-adapted oracle; KL
+over probs / log-probs × reduction against scipy entropy; AUC over
+sorted-both-ways random curves (small + large) against sklearn auc.
+"""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.special import softmax
+from scipy.stats import entropy
+from sklearn.metrics import auc as sk_auc_fn
+from sklearn.metrics import hinge_loss as sk_hinge_loss
+from sklearn.preprocessing import OneHotEncoder
+
+from metrics_tpu import AUC, Hinge, KLDivergence
+from metrics_tpu.functional import auc, hinge, kl_divergence
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES, MetricTester
+
+rng = np.random.RandomState(42)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_hinge_binary = Input(
+    preds=rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=rng.randint(0, 2, (NUM_BATCHES, BATCH_SIZE)),
+)
+_hinge_multiclass = Input(
+    preds=rng.randn(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES).astype(np.float32),
+    target=rng.randint(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE)),
+)
+
+
+def _sk_hinge(preds, target, squared, multiclass_mode):
+    """Reference `test_hinge.py:42-74` (sklearn-adapted; squared and
+    one-vs-all built from the margin directly)."""
+    sk_preds, sk_target = np.asarray(preds, np.float64), np.asarray(target)
+
+    if multiclass_mode == "one-vs-all":
+        enc = OneHotEncoder()
+        enc.fit(sk_target.reshape(-1, 1))
+        sk_target = enc.transform(sk_target.reshape(-1, 1)).toarray()
+
+    if sk_preds.ndim == 1 or multiclass_mode == "one-vs-all":
+        sk_target = 2 * sk_target - 1
+
+    if squared or sk_target.max() != 1 or sk_target.min() != -1:
+        if sk_preds.ndim == 1 or multiclass_mode == "one-vs-all":
+            margin = sk_target * sk_preds
+        else:
+            mask = np.ones_like(sk_preds, dtype=bool)
+            mask[np.arange(sk_target.shape[0]), sk_target] = False
+            margin = sk_preds[~mask]
+            margin -= np.max(sk_preds[mask].reshape(sk_target.shape[0], -1), axis=1)
+        measures = np.clip(1 - margin, 0, None)
+        if squared:
+            measures = measures**2
+        return measures.mean(axis=0)
+    if multiclass_mode == "one-vs-all":
+        return np.asarray([
+            sk_hinge_loss(y_true=sk_target[:, i], pred_decision=sk_preds[:, i])
+            for i in range(sk_preds.shape[1])
+        ])
+    return sk_hinge_loss(y_true=sk_target, pred_decision=sk_preds)
+
+
+@pytest.mark.parametrize(
+    "preds, target, squared, multiclass_mode",
+    [
+        (_hinge_binary.preds, _hinge_binary.target, False, None),
+        (_hinge_binary.preds, _hinge_binary.target, True, None),
+        (_hinge_multiclass.preds, _hinge_multiclass.target, False, "crammer-singer"),
+        (_hinge_multiclass.preds, _hinge_multiclass.target, True, "crammer-singer"),
+        (_hinge_multiclass.preds, _hinge_multiclass.target, False, "one-vs-all"),
+        (_hinge_multiclass.preds, _hinge_multiclass.target, True, "one-vs-all"),
+    ],
+    ids=["binary", "binary_sq", "cs", "cs_sq", "ova", "ova_sq"],
+)
+class TestHingeMatrix(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_hinge_class(self, preds, target, squared, multiclass_mode, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Hinge,
+            sk_metric=partial(_sk_hinge, squared=squared, multiclass_mode=multiclass_mode),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"squared": squared, "multiclass_mode": multiclass_mode},
+            check_jit=False,
+        )
+
+    def test_hinge_fn(self, preds, target, squared, multiclass_mode):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=hinge,
+            sk_metric=partial(_sk_hinge, squared=squared, multiclass_mode=multiclass_mode),
+            metric_args={"squared": squared, "multiclass_mode": multiclass_mode},
+        )
+
+
+def test_hinge_wrong_params():
+    """Reference `test_hinge.py:125-155`: bad mode / shape mismatches raise."""
+    with pytest.raises(ValueError):
+        hinge(jnp.asarray(_hinge_multiclass.preds[0]), jnp.asarray(_hinge_multiclass.target[0]),
+              multiclass_mode="bogus")
+    with pytest.raises(ValueError):
+        hinge(jnp.asarray([[-1.0, 1.0]]), jnp.asarray([0, 1]))  # batch mismatch
+
+
+# -- KL divergence ----------------------------------------------------------
+_kl_p = rng.rand(NUM_BATCHES, BATCH_SIZE, EXTRA_DIM).astype(np.float32) + 1e-3
+_kl_q = rng.rand(NUM_BATCHES, BATCH_SIZE, EXTRA_DIM).astype(np.float32) + 1e-3
+_kl_logp = np.log(softmax(rng.rand(NUM_BATCHES, BATCH_SIZE, EXTRA_DIM).astype(np.float32), axis=-1))
+_kl_logq = np.log(softmax(rng.rand(NUM_BATCHES, BATCH_SIZE, EXTRA_DIM).astype(np.float32), axis=-1))
+
+
+def _sk_kl(p, q, log_prob, reduction):
+    """Reference `test_kl_divergence.py:46-56`: scipy entropy (normalizes
+    unnormalized probs itself)."""
+    p, q = np.asarray(p, np.float64), np.asarray(q, np.float64)
+    if log_prob:
+        p, q = softmax(p, axis=1), softmax(q, axis=1)
+    res = entropy(p, q, axis=1)
+    return {"mean": np.mean, "sum": np.sum}[reduction](res)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+@pytest.mark.parametrize(
+    "p, q, log_prob",
+    [(_kl_p, _kl_q, False), (_kl_logp, _kl_logq, True)],
+    ids=["probs", "log_probs"],
+)
+class TestKLDivergenceMatrix(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    def test_kl_class(self, p, q, log_prob, reduction, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=p,
+            target=q,
+            metric_class=KLDivergence,
+            sk_metric=partial(_sk_kl, log_prob=log_prob, reduction=reduction),
+            metric_args={"log_prob": log_prob, "reduction": reduction},
+            check_jit=False,
+        )
+
+    def test_kl_fn(self, p, q, log_prob, reduction):
+        self.run_functional_metric_test(
+            p,
+            q,
+            metric_functional=kl_divergence,
+            sk_metric=partial(_sk_kl, log_prob=log_prob, reduction=reduction),
+            metric_args={"log_prob": log_prob, "reduction": reduction},
+        )
+
+
+# -- AUC --------------------------------------------------------------------
+def _make_curve(n, direction):
+    x = np.sort(rng.rand(n).astype(np.float64))
+    y = rng.rand(n).astype(np.float64)
+    if direction == "desc":
+        x, y = x[::-1].copy(), y[::-1].copy()
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+@pytest.mark.parametrize("n", [8 * NUM_BATCHES, 512 * NUM_BATCHES], ids=["small", "large"])
+@pytest.mark.parametrize("direction", ["asc", "desc"])
+def test_auc_matrix(n, direction):
+    """Sorted-both-ways curves, accumulated batch-wise, vs sklearn auc
+    (reference `test_auc.py:44-86`)."""
+    x, y = _make_curve(n, direction)
+    expected = sk_auc_fn(x[::-1], y[::-1]) if direction == "desc" else sk_auc_fn(x, y)
+
+    m = AUC()
+    for xb, yb in zip(x.reshape(NUM_BATCHES, -1), y.reshape(NUM_BATCHES, -1)):
+        m.update(jnp.asarray(xb), jnp.asarray(yb))
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-4, rtol=1e-4)
+    np.testing.assert_allclose(float(auc(jnp.asarray(x), jnp.asarray(y))), expected, atol=1e-4, rtol=1e-4)
+
+
+def test_auc_reorder():
+    """Unsorted x needs reorder=True (reference `test_auc.py:89-100`)."""
+    x = jnp.asarray([1.0, 3.0, 2.0, 4.0])
+    y = jnp.asarray([1.0, 2.0, 3.0, 4.0])
+    with pytest.raises(ValueError, match="reorder"):
+        auc(x, y)
+    np.testing.assert_allclose(
+        float(auc(x, y, reorder=True)),
+        sk_auc_fn(np.sort(np.asarray(x)), np.asarray(y)[np.argsort(np.asarray(x))]),
+        atol=1e-6,
+    )

@@ -457,6 +457,18 @@ class MetricTester:
         _assert_allclose(result, sk_result, atol=self.atol)
 
 
+def accumulate_and_merge(metric_factory, preds, target, world, num_batches=NUM_BATCHES):
+    """Round-robin batch updates over `world` instances, merge, compute —
+    the shared merge-semantics harness for curve/binned matrices."""
+    ms = [metric_factory() for _ in range(world)]
+    for i in range(num_batches):
+        ms[i % world].update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    merged = ms[0]
+    for m in ms[1:]:
+        merged.merge_state(m)
+    return merged.compute()
+
+
 class DummyMetric(Metric):
     name = "Dummy"
 
