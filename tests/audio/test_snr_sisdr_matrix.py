@@ -1,0 +1,66 @@
+"""SNR / SI-SDR per-step sync, sharded-mesh, and bf16 axes.
+
+Extends `tests/audio/test_audio.py` (which already covers class ddp ×
+zero_mean and per-sample functional parity, using the shared numpy oracles
+imported here) with the axes the reference's `tests/audio/test_si_sdr.py`
+exercises and that file does not: dist_sync_on_step, real shard_map
+collectives, and bfloat16.
+"""
+from collections import namedtuple
+from functools import partial
+
+import numpy as np
+import pytest
+
+from metrics_tpu import SI_SDR, SNR
+from tests.audio.test_audio import _np_si_sdr, _np_snr
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+TIME = 100
+rng = np.random.RandomState(2020)
+
+Input = namedtuple("Input", ["preds", "target"])
+inputs = Input(
+    preds=rng.randn(NUM_BATCHES, BATCH_SIZE, TIME).astype(np.float32),
+    target=rng.randn(NUM_BATCHES, BATCH_SIZE, TIME).astype(np.float32),
+)
+
+
+def _avg_oracle(fn, zero_mean):
+    return lambda p, t: fn(p, t, zero_mean=zero_mean).mean()
+
+
+@pytest.mark.parametrize("zero_mean", [True, False])
+@pytest.mark.parametrize(
+    "metric_class, oracle",
+    [(SNR, _np_snr), (SI_SDR, _np_si_sdr)],
+    ids=["snr", "si_sdr"],
+)
+class TestSNRFamilyDistAxes(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    def test_per_step_sync(self, metric_class, oracle, zero_mean, ddp):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=metric_class,
+            sk_metric=_avg_oracle(oracle, zero_mean),
+            dist_sync_on_step=True,
+            metric_args={"zero_mean": zero_mean},
+        )
+
+    def test_sharded(self, metric_class, oracle, zero_mean):
+        self.run_sharded_metric_test(
+            preds=inputs.preds,
+            target=inputs.target,
+            metric_class=metric_class,
+            sk_metric=_avg_oracle(oracle, zero_mean),
+            metric_args={"zero_mean": zero_mean},
+        )
+
+    def test_bf16(self, metric_class, oracle, zero_mean):
+        self.run_precision_test(
+            inputs.preds, inputs.target, metric_class, None, {"zero_mean": zero_mean}, atol=0.5
+        )

@@ -1,0 +1,132 @@
+"""R2Score / ExplainedVariance single/multi-target × multioutput matrices.
+
+Mirror of the reference's `tests/regression/test_r2.py` (adjusted ∈ {0,5,10}
+× multioutput × targets × ddp × per-step sync) and
+`test_explained_variance.py` (multioutput × targets × ddp × per-step sync),
+both against sklearn.
+"""
+from collections import namedtuple
+from functools import partial
+
+import numpy as np
+import pytest
+from sklearn.metrics import explained_variance_score as sk_ev
+from sklearn.metrics import r2_score as sk_r2score
+
+from metrics_tpu import ExplainedVariance, R2Score
+from metrics_tpu.functional import explained_variance, r2_score
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+NUM_TARGETS = 5
+rng = np.random.RandomState(42)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_single = Input(
+    preds=rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+)
+_multi = Input(
+    preds=rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_TARGETS).astype(np.float32),
+    target=rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_TARGETS).astype(np.float32),
+)
+
+
+def _sk_r2(preds, target, adjusted, multioutput, num_outputs):
+    p = preds.reshape(-1, num_outputs) if num_outputs > 1 else preds.reshape(-1)
+    t = target.reshape(-1, num_outputs) if num_outputs > 1 else target.reshape(-1)
+    score = sk_r2score(t, p, multioutput=multioutput)
+    if adjusted != 0:
+        n = p.shape[0]
+        score = 1 - (1 - score) * (n - 1) / (n - adjusted - 1)
+    return score
+
+
+def _sk_explained_variance(preds, target, multioutput, num_outputs):
+    p = preds.reshape(-1, num_outputs) if num_outputs > 1 else preds.reshape(-1)
+    t = target.reshape(-1, num_outputs) if num_outputs > 1 else target.reshape(-1)
+    return sk_ev(t, p, multioutput=multioutput)
+
+
+@pytest.mark.parametrize("adjusted", [0, 5, 10])
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+@pytest.mark.parametrize(
+    "preds, target, num_outputs",
+    [
+        (_single.preds, _single.target, 1),
+        (_multi.preds, _multi.target, NUM_TARGETS),
+    ],
+    ids=["single_target", "multi_target"],
+)
+class TestR2Matrix(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_r2_class(self, adjusted, multioutput, preds, target, num_outputs, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=R2Score,
+            sk_metric=partial(_sk_r2, adjusted=adjusted, multioutput=multioutput, num_outputs=num_outputs),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args=dict(adjusted=adjusted, multioutput=multioutput, num_outputs=num_outputs),
+        )
+
+    def test_r2_fn(self, adjusted, multioutput, preds, target, num_outputs):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=r2_score,
+            sk_metric=partial(_sk_r2, adjusted=adjusted, multioutput=multioutput, num_outputs=num_outputs),
+            metric_args=dict(adjusted=adjusted, multioutput=multioutput),
+        )
+
+
+def test_r2_wrong_params():
+    """Reference `test_r2.py:110-132`: negative adjusted / bad multioutput."""
+    with pytest.raises(ValueError):
+        R2Score(adjusted=-1)
+    with pytest.raises(ValueError):
+        r2_score(np.asarray([1.0, 2.0]), np.asarray([1.0, 2.0]), multioutput="bogus")
+
+
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+@pytest.mark.parametrize(
+    "preds, target, num_outputs",
+    [
+        (_single.preds, _single.target, 1),
+        (_multi.preds, _multi.target, NUM_TARGETS),
+    ],
+    ids=["single_target", "multi_target"],
+)
+class TestExplainedVarianceMatrix(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_ev_class(self, multioutput, preds, target, num_outputs, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=ExplainedVariance,
+            sk_metric=partial(_sk_explained_variance, multioutput=multioutput, num_outputs=num_outputs),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args=dict(multioutput=multioutput),
+        )
+
+    def test_ev_fn(self, multioutput, preds, target, num_outputs):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=explained_variance,
+            sk_metric=partial(_sk_explained_variance, multioutput=multioutput, num_outputs=num_outputs),
+            metric_args=dict(multioutput=multioutput),
+        )
+
+
+def test_ev_wrong_multioutput():
+    with pytest.raises(ValueError):
+        explained_variance(np.asarray([1.0, 2.0]), np.asarray([1.0, 2.0]), multioutput="bogus")
