@@ -16,6 +16,57 @@ _PREDS = ["hello there", "general kenobi"]
 _REFS = ["hello there", "master kenobi"]
 
 
+_GRID_PREDS = _PREDS + ["the quick brown fox", "jumps over the lazy dog"]
+_GRID_REFS = _REFS + ["a quick brown fox", "leaps over a sleepy dog"]
+_GRID_BASELINE_CACHE: Dict[tuple, dict] = {}
+
+
+def _grid_baseline(idf, all_layers):
+    """batch_size=64 reference score, computed once per (idf, all_layers)."""
+    key = (idf, all_layers)
+    if key not in _GRID_BASELINE_CACHE:
+        _GRID_BASELINE_CACHE[key] = bert_score(
+            predictions=_GRID_PREDS, references=_GRID_REFS, max_length=16,
+            idf=idf, all_layers=all_layers, batch_size=64,
+        )
+    return _GRID_BASELINE_CACHE[key]
+
+
+@pytest.mark.parametrize("idf", [False, True])
+@pytest.mark.parametrize("all_layers", [False, True])
+@pytest.mark.parametrize("batch_size", [1, 2, 4])
+def test_module_functional_grid(idf, all_layers, batch_size):
+    """Reference `test_bertscore.py` grid (fn vs class × idf × all_layers ×
+    batch_size): module streaming equals the one-shot functional, and the
+    score is invariant to the embedding batch size."""
+    import jax
+
+    preds, refs = _GRID_PREDS, _GRID_REFS
+    # different batch sizes are different XLA programs: pin matmul precision
+    # so the cross-batch-size comparison is exact on TPU (bf16 default) too
+    with jax.default_matmul_precision("float32"):
+        fn = bert_score(
+            predictions=preds, references=refs, max_length=16,
+            idf=idf, all_layers=all_layers, batch_size=batch_size,
+        )
+        baseline = _grid_baseline(idf, all_layers)
+        for key in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(
+                np.asarray(fn[key]), np.asarray(baseline[key]), atol=1e-5, rtol=1e-5,
+                err_msg=f"{key} not batch-size invariant",
+            )
+
+        m = BERTScore(max_length=16, idf=idf, all_layers=all_layers, batch_size=batch_size)
+        m.update(preds[:2], refs[:2])
+        m.update(preds[2:], refs[2:])
+        streamed = m.compute()
+        for key in ("precision", "recall", "f1"):
+            np.testing.assert_allclose(
+                np.asarray(streamed[key]), np.asarray(fn[key]), atol=1e-6,
+                err_msg=f"{key} module != functional",
+            )
+
+
 def test_identical_sentences_score_one():
     out = bert_score(predictions=_PREDS, references=_PREDS, max_length=16)
     np.testing.assert_allclose(out["precision"], 1.0, atol=1e-3)
