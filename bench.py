@@ -85,6 +85,29 @@ def _ensure_backend(probe_timeouts=(240, 60)) -> str:
     return jax.devices()[0].platform
 
 
+def _enable_persistent_compile_cache() -> None:
+    """Cache XLA compiles on disk (repo-local ``.jax_cache/``).
+
+    Compiles through the remote-TPU tunnel are the dominant bench cost (e.g.
+    287 s for the Inception update program, 35 s cold for a trivial step —
+    BENCH_TPU_r03_raw.jsonl); the persistent cache makes every rerun across
+    tunnel windows pay steady-state only. Uses the packaged helper
+    (`metrics_tpu/utils/compile_cache.py`) pointed at a repo-local dir so
+    bench runs are hermetic. NOTE: once the cache is warm, `compile_s`
+    diagnostics measure cache-hit deserialization, not cold XLA compile —
+    the emitted `compile_cache` diagnostic marks which regime a run was in.
+    """
+    try:
+        from metrics_tpu.utils import compile_cache
+
+        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+        path = compile_cache.enable(cache_dir, min_compile_seconds=2)
+        pre_warmed = bool(os.listdir(path))
+        _diag(compile_cache=("warm" if pre_warmed else "cold"), dir=path)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization, never fatal
+        _diag(compile_cache=f"disabled: {type(e).__name__}: {e}"[:200])
+
+
 def _diag(**kv) -> None:
     print(json.dumps({"diagnostic": kv}), file=sys.stderr)
 
@@ -543,6 +566,7 @@ def bench_config6() -> None:
 def main() -> None:
     try:
         platform = _ensure_backend()
+        _enable_persistent_compile_cache()
         _diag(platform=platform)
         ours = bench_ours()
     except Exception as e:  # noqa: BLE001 — contract line must appear no matter what

@@ -1,0 +1,67 @@
+#!/bin/bash
+# TPU-window watchdog: probe the axon tunnel periodically; when it answers,
+# run the still-missing bench configs (6 = pallas-vs-XLA, 7 = north star,
+# 4 = BERTScore) and the compiled-pallas hardware proof, appending evidence
+# to the repo so a flapping window is never wasted. Evidence is only recorded
+# (and the config only marked captured) when the run BOTH reported
+# platform=tpu and emitted its metric marker — a mid-run tunnel death or a
+# CPU fallback leaves the config queued for the next window.
+# Usage: bash scripts/tpu_watchdog.sh   (detached via setsid; kill by pgrep)
+cd /root/repo || exit 1
+LOG=probe_log.txt
+RAW=BENCH_TPU_r03_raw.jsonl
+
+probe() {
+  timeout 75 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert any("TPU" in str(d) or d.platform in ("tpu", "axon") for d in jax.devices())
+EOF
+}
+
+need() { # need <marker> — true when marker absent from $RAW
+  ! grep -q "$1" "$RAW" 2>/dev/null
+}
+
+run_cfg() { # run_cfg <n> <marker> <timeout_s>
+  local n=$1 marker=$2 budget=$3 rc
+  need "$marker" || return 0
+  echo "$(date -u +%FT%TZ) watchdog: running config $n (budget ${budget}s)" | tee -a "$LOG"
+  timeout "$budget" python bench.py --config "$n" >/tmp/wd_c$n.out 2>/tmp/wd_c$n.err
+  rc=$?
+  # capture only a genuine TPU run that actually emitted this config's metric
+  if grep -q '"platform": "tpu"' /tmp/wd_c$n.err && grep -q "$marker" /tmp/wd_c$n.out; then
+    grep -v fused_metric_step_time /tmp/wd_c$n.out >>"$RAW"
+    grep -h '"diagnostic".*"config": '"$n" /tmp/wd_c$n.err >>"$RAW" 2>/dev/null
+    echo "$(date -u +%FT%TZ) watchdog: config $n DONE (rc=$rc)" | tee -a "$LOG"
+  else
+    echo "$(date -u +%FT%TZ) watchdog: config $n NOT captured (rc=$rc; platform/marker missing) — will retry" | tee -a "$LOG"
+  fi
+}
+
+while :; do
+  if probe; then
+    echo "$(date -u +%FT%TZ) probe: ALIVE (watchdog)" >>"$LOG"
+    if need pallas_proof; then
+      timeout 600 python scripts/pallas_tpu_proof.py >/tmp/wd_pallas.out 2>/tmp/wd_pallas.err
+      prc=$?
+      # record the proof line whatever the verdict — a parity FAIL on real
+      # hardware is itself the evidence VERDICT item 2 asks for
+      if grep -q pallas_proof /tmp/wd_pallas.out; then
+        grep pallas_proof /tmp/wd_pallas.out >>"$RAW"
+        echo "$(date -u +%FT%TZ) watchdog: pallas proof recorded (rc=$prc)" | tee -a "$LOG"
+      else
+        echo "$(date -u +%FT%TZ) watchdog: pallas proof produced no line (rc=$prc) — will retry" | tee -a "$LOG"
+      fi
+    fi
+    run_cfg 6 binned_pr_stats 900
+    run_cfg 7 metric_overhead_vs_forward 1200
+    run_cfg 4 bertscore_compute 1800
+    if ! need binned_pr_stats && ! need metric_overhead_vs_forward && ! need bertscore_compute && ! need pallas_proof; then
+      echo "$(date -u +%FT%TZ) watchdog: ALL PAYLOADS CAPTURED — exiting" | tee -a "$LOG"
+      exit 0
+    fi
+  else
+    echo "$(date -u +%FT%TZ) probe: HUNG (watchdog, killed at 75s)" >>"$LOG"
+  fi
+  sleep 420
+done

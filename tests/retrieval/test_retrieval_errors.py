@@ -1,0 +1,211 @@
+"""Retrieval argument-validation matrices + extra input fixtures.
+
+Breadth analogue of the reference's error grids
+(`/root/reference/tests/retrieval/helpers.py:126-280` — the
+`_errors_test_{class,functional}_metric_parameters_*` tables driven through
+every retrieval metric in `test_{map,mrr,precision,recall,fallout,ndcg}.py`)
+and its extra fixtures (`tests/retrieval/inputs.py`: multidim `_irs_extra`,
+non-binary `_irs_int_tgt`/`_irs_float_tgt`). Every case asserts the same
+user-facing message the reference standardizes on.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import ndcg_score
+
+from metrics_tpu import (
+    RetrievalFallOut,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
+from metrics_tpu.functional import (
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+
+_CLASSES = [RetrievalMAP, RetrievalMRR, RetrievalPrecision, RetrievalRecall, RetrievalFallOut, RetrievalNormalizedDCG]
+_K_CLASSES = [RetrievalPrecision, RetrievalRecall, RetrievalFallOut, RetrievalNormalizedDCG]
+_BINARY_CLASSES = [c for c in _CLASSES if not c.allow_non_binary_target]
+_FUNCTIONALS = [
+    retrieval_average_precision,
+    retrieval_reciprocal_rank,
+    retrieval_precision,
+    retrieval_recall,
+    retrieval_fall_out,
+    retrieval_normalized_dcg,
+]
+_K_FUNCTIONALS = [retrieval_precision, retrieval_recall, retrieval_fall_out, retrieval_normalized_dcg]
+_BINARY_FUNCTIONALS = [retrieval_average_precision, retrieval_reciprocal_rank, retrieval_precision,
+                       retrieval_recall, retrieval_fall_out]
+
+_N = 16
+_rng = np.random.RandomState(3)
+_idx = jnp.asarray(_rng.randint(0, 4, (_N,)))
+_preds = jnp.asarray(_rng.rand(_N).astype(np.float32))
+_target = jnp.asarray(_rng.randint(0, 2, (_N,)))
+
+
+def _ids(objs):
+    return [getattr(o, "__name__", type(o).__name__) for o in objs]
+
+
+# ---------------------------------------------------------------------------
+# class-metric argument errors (reference helpers.py:189-280)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("cls", _CLASSES, ids=_ids(_CLASSES))
+class TestClassArgErrors:
+    def test_indexes_none(self, cls):
+        m = cls()
+        with pytest.raises(ValueError, match="`indexes` cannot be None"):
+            m.update(_preds, _target, indexes=None)
+
+    def test_wrong_empty_target_action(self, cls):
+        with pytest.raises(ValueError, match="`empty_target_action` received a wrong value `casual_argument`"):
+            cls(empty_target_action="casual_argument")
+
+    def test_mismatching_shapes(self, cls):
+        m = cls()
+        with pytest.raises(ValueError, match="must be of the same shape"):
+            m.update(_preds[:-2], _target, indexes=_idx)
+
+    def test_empty_inputs(self, cls):
+        m = cls()
+        with pytest.raises(ValueError, match="non-empty and non-scalar"):
+            m.update(jnp.zeros((0,)), jnp.zeros((0,), jnp.int32), indexes=jnp.zeros((0,), jnp.int32))
+
+    def test_scalar_inputs(self, cls):
+        m = cls()
+        with pytest.raises(ValueError, match="non-empty and non-scalar"):
+            m.update(jnp.asarray(0.5), jnp.asarray(1), indexes=jnp.asarray(0))
+
+    def test_float_indexes(self, cls):
+        m = cls()
+        with pytest.raises(ValueError, match="`indexes` must be a tensor of long integers"):
+            m.update(_preds, _target, indexes=_preds)
+
+    def test_bool_preds(self, cls):
+        m = cls()
+        with pytest.raises(ValueError, match="`preds` must be a tensor of floats"):
+            m.update(_target.astype(jnp.bool_), _target, indexes=_idx)
+
+
+@pytest.mark.parametrize("cls", _BINARY_CLASSES, ids=_ids(_BINARY_CLASSES))
+def test_class_nonbinary_target_rejected(cls):
+    m = cls()
+    with pytest.raises(ValueError, match="`target` must contain `binary` values"):
+        m.update(_preds, jnp.asarray(_rng.randint(-1, 4, (_N,))), indexes=_idx)
+
+
+@pytest.mark.parametrize("cls", _K_CLASSES, ids=_ids(_K_CLASSES))
+@pytest.mark.parametrize("bad_k", [-10, 0, 4.0, True], ids=["neg", "zero", "float", "bool"])
+def test_class_invalid_k(cls, bad_k):
+    with pytest.raises(ValueError, match="`k` has to be a positive integer or None"):
+        cls(k=bad_k)
+
+
+@pytest.mark.parametrize("cls", _CLASSES, ids=_ids(_CLASSES))
+def test_error_action_raises_on_empty_query(cls):
+    """`empty_target_action='error'`: a query with no positives (FallOut: no
+    negatives — its policy is inverted, reference fall_out.py) raises at
+    compute (reference helpers.py:160-186)."""
+    m = cls(empty_target_action="error")
+    empty_on = "negative" if cls.empty_on_negatives else "positive"
+    # query 0 is fine; query 1 is all-negative (no positive) or all-positive
+    preds = jnp.asarray([0.9, 0.2, 0.7, 0.4], dtype=jnp.float32)
+    indexes = jnp.asarray([0, 0, 1, 1])
+    if cls.empty_on_negatives:
+        target = jnp.asarray([1, 0, 1, 1])  # query 1 has no negative
+    else:
+        target = jnp.asarray([1, 0, 0, 0])  # query 1 has no positive
+    m.update(preds, target, indexes=indexes)
+    with pytest.raises(ValueError, match=f"no {empty_on} target"):
+        m.compute()
+
+
+@pytest.mark.parametrize("cls", _CLASSES, ids=_ids(_CLASSES))
+def test_num_queries_incompatible_with_error_action(cls):
+    with pytest.raises(ValueError, match="incompatible"):
+        cls(empty_target_action="error", num_queries=8)
+
+
+# ---------------------------------------------------------------------------
+# functional argument errors (reference helpers.py:126-157)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("fn", _FUNCTIONALS, ids=_ids(_FUNCTIONALS))
+class TestFunctionalArgErrors:
+    def test_mismatching_shapes(self, fn):
+        with pytest.raises(ValueError, match="`preds` and `target` must be of the same shape"):
+            fn(_preds[:-2], _target)
+
+    def test_empty_inputs(self, fn):
+        with pytest.raises(ValueError, match="non-empty and non-scalar"):
+            fn(jnp.zeros((0,)), jnp.zeros((0,), jnp.int32))
+
+    def test_bool_preds(self, fn):
+        with pytest.raises(ValueError, match="`preds` must be a tensor of floats"):
+            fn(_target.astype(jnp.bool_), _target)
+
+
+@pytest.mark.parametrize("fn", _BINARY_FUNCTIONALS, ids=_ids(_BINARY_FUNCTIONALS))
+def test_functional_nonbinary_target_rejected(fn):
+    with pytest.raises(ValueError, match="`target` must contain `binary` values"):
+        fn(_preds, jnp.asarray(_rng.randint(2, 4, (_N,))))
+
+
+@pytest.mark.parametrize("fn", _K_FUNCTIONALS, ids=_ids(_K_FUNCTIONALS))
+@pytest.mark.parametrize("bad_k", [-10, 4.0], ids=["neg", "float"])
+def test_functional_invalid_k(fn, bad_k):
+    with pytest.raises(ValueError, match="`k` has to be a positive integer or None"):
+        fn(_preds, _target, k=bad_k)
+
+
+# ---------------------------------------------------------------------------
+# extra input fixtures (reference inputs.py: _irs_extra, _irs_int/float_tgt)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("cls", _CLASSES, ids=_ids(_CLASSES))
+def test_multidim_inputs_flatten(cls):
+    """[B, EXTRA_DIM]-shaped updates (reference `_irs_extra`) score exactly
+    like their raveled 1-D form — rows are rows regardless of framing."""
+    rng = np.random.RandomState(11)
+    idx2 = rng.randint(0, 3, (8, 4))
+    preds2 = rng.rand(8, 4).astype(np.float32)
+    tgt2 = rng.randint(0, 2, (8, 4))
+    tgt2[idx2 == 0] = 1  # every query non-empty for both polarities
+    tgt2[(idx2 == 1) & (preds2 < 0.5)] = 0
+    m2d = cls(empty_target_action="skip")
+    m2d.update(jnp.asarray(preds2), jnp.asarray(tgt2), indexes=jnp.asarray(idx2))
+    m1d = cls(empty_target_action="skip")
+    m1d.update(jnp.asarray(preds2.ravel()), jnp.asarray(tgt2.ravel()), indexes=jnp.asarray(idx2.ravel()))
+    np.testing.assert_allclose(float(m2d.compute()), float(m1d.compute()), atol=1e-7)
+
+
+@pytest.mark.parametrize("make_target", [
+    pytest.param(lambda rng, n: rng.randint(0, 4, (n,)), id="int_graded"),
+    pytest.param(lambda rng, n: rng.rand(n).astype(np.float32), id="float_graded"),
+])
+def test_ndcg_nonbinary_targets_vs_sklearn(make_target):
+    """NDCG accepts graded relevance (reference `_irs_int_tgt`/`_irs_float_tgt`
+    drive test_ndcg.py); parity vs sklearn's ndcg_score per query."""
+    rng = np.random.RandomState(5)
+    n, queries = 64, 4
+    idx = np.repeat(np.arange(queries), n // queries)
+    preds = rng.rand(n).astype(np.float32)
+    target = make_target(rng, n)
+    m = RetrievalNormalizedDCG()
+    m.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+    expected = np.mean([
+        ndcg_score(target[idx == q][None, :], preds[idx == q][None, :]) for q in range(queries)
+    ])
+    np.testing.assert_allclose(float(m.compute()), expected, atol=1e-5)
+    # functional form, one query at a time
+    for q in range(queries):
+        got = float(retrieval_normalized_dcg(jnp.asarray(preds[idx == q]), jnp.asarray(target[idx == q])))
+        want = ndcg_score(target[idx == q][None, :], preds[idx == q][None, :])
+        np.testing.assert_allclose(got, want, atol=1e-5)
