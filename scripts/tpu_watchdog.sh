@@ -41,6 +41,8 @@ run_cfg() { # run_cfg <n> <marker> <timeout_s>
 while :; do
   if probe; then
     echo "$(date -u +%FT%TZ) probe: ALIVE (watchdog)" >>"$LOG"
+    # north star first — the one number two rounds of VERDICTs asked for
+    run_cfg 7 metric_overhead_vs_forward 1500
     if need pallas_proof; then
       timeout 600 python scripts/pallas_tpu_proof.py >/tmp/wd_pallas.out 2>/tmp/wd_pallas.err
       prc=$?
@@ -54,7 +56,6 @@ while :; do
       fi
     fi
     run_cfg 6 binned_pr_stats 900
-    run_cfg 7 metric_overhead_vs_forward 1200
     run_cfg 4 bertscore_compute 1800
     if ! need binned_pr_stats && ! need metric_overhead_vs_forward && ! need bertscore_compute && ! need pallas_proof; then
       echo "$(date -u +%FT%TZ) watchdog: ALL PAYLOADS CAPTURED — exiting" | tee -a "$LOG"

