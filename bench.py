@@ -75,7 +75,14 @@ def _ensure_backend(probe_timeouts=(240, 60)) -> str:
         time.sleep(5)
 
     print(
-        json.dumps({"diagnostic": "accelerator backend unavailable, falling back to cpu", "error": last_err}),
+        json.dumps(
+            {
+                "diagnostic": "accelerator backend unavailable, falling back to cpu",
+                "error": last_err,
+                "tpu_evidence": "BENCH_TPU_r03_raw.jsonl records driver-path TPU runs "
+                "from reachable windows; probe_log.txt records the outage",
+            }
+        ),
         file=sys.stderr,
     )
     os.environ["JAX_PLATFORMS"] = "cpu"
