@@ -1,0 +1,155 @@
+"""Property-based fuzzing, part 2: cat-state metrics, retrieval, text.
+
+Targets the runtime's novel paths specifically: batch-split invariance for
+CAT-state metrics (CatBuffer/list accumulation + merge is the redesigned
+machinery), rank/tie handling vs scipy, segment-op retrieval vs a per-query
+numpy loop, and the WER counter vs an independent DP oracle.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+from scipy.stats import spearmanr
+from sklearn.metrics import average_precision_score
+
+from metrics_tpu import AUROC, RetrievalMAP, SpearmanCorrcoef
+from metrics_tpu.functional import retrieval_reciprocal_rank, spearman_corrcoef, wer
+
+N = 24
+COMMON = dict(max_examples=30, deadline=None)
+
+_scores = st.lists(
+    st.floats(0.0, 1.0, allow_nan=False, allow_infinity=False, width=32).filter(
+        lambda x: x == 0.0 or x > 1.2e-38  # XLA flushes f32 subnormals (FTZ)
+    ),
+    min_size=N,
+    max_size=N,
+)
+_bin_target = st.lists(st.integers(0, 1), min_size=N, max_size=N)
+# few distinct values -> dense ties, the hard case for rank averaging
+_tie_heavy = st.lists(st.sampled_from([0.0, 0.25, 0.5, 0.75, 1.0]), min_size=N, max_size=N)
+
+
+@settings(**COMMON)
+@given(scores=_scores, target=_bin_target, data=st.data())
+def test_auroc_cat_state_batch_split_invariance(scores, target, data):
+    """AUROC accumulates raw rows in a cat state; its value must not depend
+    on how the stream was batched — including through merge_states."""
+    t = np.asarray(target)
+    if t.min() == t.max():
+        return
+    s = np.asarray(scores, dtype=np.float32)
+    split = data.draw(st.integers(1, N - 1))
+
+    whole = AUROC()
+    whole.update(jnp.asarray(s), jnp.asarray(t))
+
+    parts = AUROC()
+    parts.update(jnp.asarray(s[:split]), jnp.asarray(t[:split]))
+    parts.update(jnp.asarray(s[split:]), jnp.asarray(t[split:]))
+    np.testing.assert_allclose(float(whole.compute()), float(parts.compute()), atol=1e-6)
+
+    a, b = AUROC(), AUROC()
+    a.update(jnp.asarray(s[:split]), jnp.asarray(t[:split]))
+    b.update(jnp.asarray(s[split:]), jnp.asarray(t[split:]))
+    a.merge_state(b)  # in-place merge into `a`
+    np.testing.assert_allclose(float(a.compute()), float(whole.compute()), atol=1e-6)
+
+
+@settings(**COMMON)
+@given(preds=_tie_heavy, target=_tie_heavy)
+def test_spearman_with_dense_ties_matches_scipy(preds, target):
+    p = np.asarray(preds, dtype=np.float32)
+    t = np.asarray(target, dtype=np.float32)
+    if np.std(p) == 0 or np.std(t) == 0:  # correlation undefined
+        return
+    got = float(spearman_corrcoef(jnp.asarray(p), jnp.asarray(t)))
+    want = spearmanr(p, t).statistic
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+    m = SpearmanCorrcoef()
+    m.update(jnp.asarray(p[: N // 2]), jnp.asarray(t[: N // 2]))
+    m.update(jnp.asarray(p[N // 2 :]), jnp.asarray(t[N // 2 :]))
+    np.testing.assert_allclose(float(m.compute()), want, atol=1e-5)
+
+
+@settings(**COMMON)
+@given(
+    perm=st.permutations(list(range(N))),
+    target=_bin_target,
+    qids=st.lists(st.integers(0, 3), min_size=N, max_size=N),
+)
+def test_retrieval_map_matches_numpy_loop(perm, target, qids):
+    """Segment-op MAP vs a per-query numpy loop over arbitrary (possibly
+    empty, possibly single-row) query groups, skip policy.
+
+    Scores are a hypothesis-chosen permutation of DISTINCT values: under
+    tied scores sklearn's AP is tie-aware (threshold-based) while sort-based
+    AP — ours and the reference's `retrieval_average_precision` alike — is
+    order-dependent, so ties have no common oracle."""
+    s = (np.asarray(perm, dtype=np.float32) + 1.0) / (N + 1)
+    t = np.asarray(target)
+    q = np.asarray(qids)
+
+    m = RetrievalMAP(empty_target_action="skip")
+    m.update(jnp.asarray(s), jnp.asarray(t), indexes=jnp.asarray(q))
+    got = float(m.compute())
+
+    scores_per_q = []
+    for qid in np.unique(q):
+        tq, sq = t[q == qid], s[q == qid]
+        if tq.sum() == 0:
+            continue
+        scores_per_q.append(average_precision_score(tq, sq))
+    want = np.mean(scores_per_q) if scores_per_q else 0.0
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@settings(**COMMON)
+@given(scores=_scores, target=_bin_target)
+def test_reciprocal_rank_first_hit_property(scores, target):
+    """RR == 1/(rank of best-scored positive); brute-forced via argsort."""
+    s = np.asarray(scores, dtype=np.float32)
+    t = np.asarray(target)
+    got = float(retrieval_reciprocal_rank(jnp.asarray(s), jnp.asarray(t)))
+    order = np.argsort(-s, kind="stable")
+    ranked = t[order]
+    hits = np.flatnonzero(ranked)
+    want = 0.0 if hits.size == 0 else 1.0 / (hits[0] + 1)
+    # ties: our sort may place tied scores in any order; accept any rank
+    # within the tie block of the first hit
+    if hits.size and np.sum(s == s[order[hits[0]]]) > 1:
+        tied_val = s[order[hits[0]]]
+        block = np.flatnonzero(s == tied_val)
+        lo = np.sum(s > tied_val) + 1
+        hi = lo + block.size - 1
+        assert any(abs(got - 1.0 / r) < 1e-6 for r in range(lo, hi + 1))
+    else:
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+_words = st.lists(st.sampled_from("a b c d aa bb cc".split()), min_size=0, max_size=8)
+
+
+def _levenshtein(ref, hyp):
+    dp = np.arange(len(ref) + 1, dtype=np.int64)
+    for j in range(1, len(hyp) + 1):
+        prev = dp.copy()
+        dp[0] = j
+        for i in range(1, len(ref) + 1):
+            dp[i] = min(prev[i] + 1, dp[i - 1] + 1, prev[i - 1] + (ref[i - 1] != hyp[j - 1]))
+    return dp[-1]
+
+
+@settings(**COMMON)
+@given(refs=st.lists(_words, min_size=1, max_size=4), data=st.data())
+def test_wer_matches_dp_oracle(refs, data):
+    """WER vs an independent edit-distance DP over random word sequences."""
+    refs = [r for r in refs if r]  # empty references are rejected by wer
+    if not refs:
+        return
+    hyps = [data.draw(_words) for _ in refs]
+    got = float(wer([" ".join(h) for h in hyps], [" ".join(r) for r in refs]))
+    errs = sum(_levenshtein(r, h) for r, h in zip(refs, hyps))
+    total = sum(len(r) for r in refs)
+    np.testing.assert_allclose(got, errs / total, atol=1e-6)
