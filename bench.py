@@ -306,7 +306,32 @@ def bench_config2() -> None:
     upper_bound = per_step < resolution
     _diag(config=2, compile_s=round(compile_s, 1), upper_bound=upper_bound,
           resolution_us=round(resolution * 1e6, 2))
-    _emit("auroc_confmat_fused_step", round(max(per_step, resolution) * 1e6, 2), "us/step")
+
+    # reference mechanism, torch-CPU: AUROC keeps growing python-list cat
+    # states (classification/auroc.py cat states) and ConfusionMatrix does a
+    # bincount scatter-add per step (functional/.../confusion_matrix.py) —
+    # timed over the same batch stream (fewer steps, averaged)
+    vs = None
+    try:
+        import torch
+
+        tp = torch.from_numpy(np.asarray(preds))
+        tt = torch.from_numpy(np.asarray(target))
+        preds_list, target_list = [], []
+        confmat = torch.zeros(2, 2)
+        base_steps = 512
+        t0 = time.perf_counter()
+        for _ in range(base_steps):
+            preds_list.append(tp)
+            target_list.append(tt)
+            binary = (tp >= 0.5).long()
+            unique = binary * 2 + tt
+            confmat += torch.bincount(unique, minlength=4).reshape(2, 2).float()
+        base_per_step = (time.perf_counter() - t0) / base_steps
+        vs = round(base_per_step / max(per_step, resolution), 3)
+    except Exception:  # noqa: BLE001 — baseline is comparative garnish
+        pass
+    _emit("auroc_confmat_fused_step", round(max(per_step, resolution) * 1e6, 2), "us/step", vs)
 
 
 def bench_config3() -> None:
