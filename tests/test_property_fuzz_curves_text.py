@@ -1,0 +1,107 @@
+"""Property-based fuzzing, part 5: curve structure and text identities.
+
+Curves have shape-level invariants independent of any oracle: ROC moves
+monotonically from (0,0) to (1,1), precision-recall endpoints are pinned,
+calibration error lives in [0,1]. Text metrics have exact self-identities.
+Hypothesis searches values; shapes stay fixed.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from metrics_tpu.functional import (
+    bleu_score,
+    calibration_error,
+    precision_recall_curve,
+    roc,
+    rouge_score,
+    wer,
+)
+
+N = 24
+COMMON = dict(max_examples=30, deadline=None)
+
+_scores = st.lists(
+    st.floats(0.0, 1.0, allow_nan=False, allow_infinity=False, width=32).filter(
+        lambda x: x == 0.0 or x > 1.2e-38  # XLA FTZ
+    ),
+    min_size=N,
+    max_size=N,
+)
+_bin_target = st.lists(st.integers(0, 1), min_size=N, max_size=N)
+
+
+@settings(**COMMON)
+@given(scores=_scores, target=_bin_target)
+def test_roc_monotone_between_corners(scores, target):
+    t = np.asarray(target)
+    if t.min() == t.max():
+        return
+    s = jnp.asarray(np.asarray(scores, np.float32))
+    fpr, tpr, _ = roc(s, jnp.asarray(t), pos_label=1)
+    fpr, tpr = np.asarray(fpr), np.asarray(tpr)
+    assert np.all(np.diff(fpr) >= -1e-7), "fpr must be nondecreasing"
+    assert np.all(np.diff(tpr) >= -1e-7), "tpr must be nondecreasing"
+    assert fpr[0] == pytest.approx(0.0) and tpr[0] == pytest.approx(0.0)
+    assert fpr[-1] == pytest.approx(1.0) and tpr[-1] == pytest.approx(1.0)
+    assert np.all((fpr >= -1e-7) & (fpr <= 1 + 1e-7))
+    assert np.all((tpr >= -1e-7) & (tpr <= 1 + 1e-7))
+
+
+@settings(**COMMON)
+@given(scores=_scores, target=_bin_target)
+def test_pr_curve_bounds_and_endpoint(scores, target):
+    t = np.asarray(target)
+    if t.sum() == 0:  # no positives: precision undefined everywhere
+        return
+    s = jnp.asarray(np.asarray(scores, np.float32))
+    precision, recall, _ = precision_recall_curve(s, jnp.asarray(t), pos_label=1)
+    precision, recall = np.asarray(precision), np.asarray(recall)
+    assert np.all((precision >= -1e-7) & (precision <= 1 + 1e-7))
+    assert np.all((recall >= -1e-7) & (recall <= 1 + 1e-7))
+    # reference convention: curve ends at (recall=0, precision=1)
+    assert precision[-1] == pytest.approx(1.0)
+    assert recall[-1] == pytest.approx(0.0)
+    assert np.all(np.diff(recall) <= 1e-7), "recall is nonincreasing along the curve"
+
+
+@settings(**COMMON)
+@given(scores=_scores, target=_bin_target, n_bins=st.sampled_from([5, 10, 15]))
+def test_calibration_error_in_unit_interval(scores, target, n_bins):
+    t = np.asarray(target)
+    s = jnp.asarray(np.asarray(scores, np.float32))
+    for norm in ("l1", "max"):
+        v = float(calibration_error(s, jnp.asarray(t), n_bins=n_bins, norm=norm))
+        assert -1e-7 <= v <= 1.0 + 1e-7, f"{norm}: {v}"
+
+
+_sentence = st.lists(
+    st.sampled_from("the a cat dog runs jumps blue red".split()), min_size=4, max_size=12
+)
+
+
+@settings(**COMMON)
+@given(sents=st.lists(_sentence, min_size=1, max_size=3))
+def test_text_self_identities(sents):
+    """Any corpus scored against itself: BLEU=1, ROUGE-1/L F=1, WER=0."""
+    texts = [" ".join(s) for s in sents]
+    refs = [[t] for t in texts]
+    np.testing.assert_allclose(float(bleu_score(refs, texts)), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(wer(texts, texts)), 0.0, atol=1e-9)
+    r = rouge_score(texts, texts)
+    np.testing.assert_allclose(float(np.asarray(r["rouge1_fmeasure"])), 1.0, atol=1e-6)
+    np.testing.assert_allclose(float(np.asarray(r["rougeL_fmeasure"])), 1.0, atol=1e-6)
+
+
+@settings(**COMMON)
+@given(sents=st.lists(_sentence, min_size=2, max_size=4), data=st.data())
+def test_bleu_corpus_order_invariance(sents, data):
+    """Corpus BLEU is a ratio of corpus-summed counts: permuting the corpus
+    order must not change it."""
+    hyps = [" ".join(s) for s in sents]
+    refs = [[" ".join(data.draw(_sentence))] for _ in sents]
+    base = float(bleu_score(refs, hyps))
+    perm = data.draw(st.permutations(list(range(len(hyps)))))
+    shuffled = float(bleu_score([refs[i] for i in perm], [hyps[i] for i in perm]))
+    np.testing.assert_allclose(base, shuffled, atol=1e-6)
