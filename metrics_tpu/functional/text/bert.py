@@ -197,6 +197,28 @@ def _read_baseline_csv(path: str) -> Array:
     return jnp.asarray(rows)[:, 1:]
 
 
+# official bert-score baseline tree (reference `functional/text/bert.py:407-425`)
+_BASELINE_URL_BASE = "https://raw.githubusercontent.com/Tiiiger/bert_score/master/bert_score/rescale_baseline"
+
+
+def _read_baseline_url(url: str, timeout: float = 30.0) -> Array:
+    """Fetch a baseline csv/tsv over HTTP (reference `_read_csv_from_url`,
+    `functional/text/bert.py:396-403`). Requires network access — offline
+    runs should pass ``baseline_path`` (see ``bundled_baseline_path``)."""
+    import io
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as response:  # noqa: S310 — user-supplied source, parity with reference
+        text = response.read().decode("utf-8")
+    delimiter = "\t" if url.endswith(".tsv") else ","
+    rows = [
+        [float(item) for item in row]
+        for idx, row in enumerate(csv.reader(io.StringIO(text), delimiter=delimiter))
+        if idx > 0
+    ]
+    return jnp.asarray(rows)[:, 1:]
+
+
 def _rescale_with_baseline(
     precision: Array,
     recall: Array,
@@ -277,6 +299,7 @@ def bert_score(
     lang: str = "en",
     rescale_with_baseline: bool = False,
     baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
     baseline: Optional[Array] = None,
 ) -> Dict[str, Union[List[float], str]]:
     """BERTScore: greedy cosine matching of contextual embeddings.
@@ -297,6 +320,11 @@ def bert_score(
         batch_size: chunk size for the embedding forward.
         rescale_with_baseline: linearly rescale with a per-layer baseline.
         baseline_path: local csv/tsv with baseline values.
+        baseline_url: fetch the baseline csv/tsv over HTTP (reference
+            `text/bert.py:142`); when neither path nor url is given and a
+            ``model_name_or_path`` is set, the official bert-score tree is
+            tried (``<base>/<lang>/<model>.tsv``). Offline runs should use
+            ``baseline_path``.
         baseline: explicit baseline array ``[n_layers(+1), 3]``.
 
     Returns:
@@ -415,6 +443,16 @@ def bert_score(
     if rescale_with_baseline:
         if baseline is None and baseline_path is not None:
             baseline = _read_baseline_csv(baseline_path)
+        if baseline is None and (baseline_url or (lang and model_name_or_path)):
+            # explicit url, or the official bert-score tree for (lang, model)
+            # — mirrors the reference's resolution chain
+            # (`functional/text/bert.py:415-425`); fetch failures degrade to
+            # the no-baseline warning instead of raising
+            url = baseline_url or f"{_BASELINE_URL_BASE}/{lang}/{model_name_or_path}.tsv"
+            try:
+                baseline = _read_baseline_url(url)
+            except Exception as err:  # noqa: BLE001 — offline/404 must not kill scoring
+                rank_zero_warn(f"Baseline fetch from {url!r} failed ({err}).")
         if baseline is None:
             rank_zero_warn("Baseline was not successfully loaded. No baseline is going to be used.")
         else:

@@ -60,6 +60,7 @@ class BERTScore(Metric):
         lang: str = "en",
         rescale_with_baseline: bool = False,
         baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
         baseline: Optional[Array] = None,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
@@ -82,6 +83,7 @@ class BERTScore(Metric):
         self.lang = lang
         self.rescale_with_baseline = rescale_with_baseline
         self.baseline_path = baseline_path
+        self.baseline_url = baseline_url
         self.baseline = baseline
 
         if user_tokenizer is not None:
@@ -142,5 +144,6 @@ class BERTScore(Metric):
             lang=self.lang,
             rescale_with_baseline=self.rescale_with_baseline,
             baseline_path=self.baseline_path,
+            baseline_url=self.baseline_url,
             baseline=self.baseline,
         )

@@ -35,14 +35,24 @@ class ROUGEScore(Metric):
 
     def __init__(
         self,
+        newline_sep: Optional[bool] = None,  # deprecated (reference v0.6); remove in v0.7
         use_stemmer: bool = False,
         rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        decimal_places: Optional[bool] = None,  # deprecated (reference v0.6); remove in v0.7
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
     ) -> None:
         super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        # accepted-but-inert deprecation kwargs, mirroring the reference
+        # (`text/rouge.py:84-102`): warn exactly as v0.6 does
+        import warnings
+
+        if newline_sep is not None:
+            warnings.warn("Argument `newline_sep` is deprecated in v0.6 and will be removed in v0.7")
+        if decimal_places is not None:
+            warnings.warn("Argument `decimal_places` is deprecated in v0.6 and will be removed in v0.7")
         if not isinstance(rouge_keys, tuple):
             rouge_keys = (rouge_keys,)
         for key in rouge_keys:

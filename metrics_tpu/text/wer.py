@@ -24,12 +24,23 @@ class WER(Metric):
 
     def __init__(
         self,
+        concatenate_texts: Optional[bool] = None,  # deprecated (reference v0.6); remove in v0.7
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
     ) -> None:
         super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        # accepted-but-inert deprecation kwarg, mirroring the reference
+        # (`text/wer.py:74-87`): the counter accumulation is equivalent for
+        # both settings, so only the warning remains
+        if concatenate_texts is not None:
+            import warnings
+
+            warnings.warn(
+                "`concatenate_texts` has been deprecated in v0.6 and it will be removed in v0.7",
+                DeprecationWarning,
+            )
         self.add_state("errors", jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
 

@@ -1,0 +1,82 @@
+"""Signature-parity additions vs the reference (AST sweep findings).
+
+The reference v0.6 ships three constructor params the repo lacked:
+ROUGEScore(newline_sep, decimal_places) and WER(concatenate_texts) —
+deprecated warn-only kwargs (`/root/reference/torchmetrics/text/rouge.py:84-102`,
+`text/wer.py:74-87`) — and BERTScore(baseline_url), a real feature
+(`text/bert.py:142`, `functional/text/bert.py:396-425`). The url path is
+exercised offline through ``file://`` URLs (urllib handles them natively).
+"""
+import numpy as np
+import pytest
+
+from metrics_tpu import WER, BERTScore, ROUGEScore
+from metrics_tpu.functional.text.bert import (
+    _read_baseline_csv,
+    _read_baseline_url,
+    bundled_baseline_path,
+)
+
+
+@pytest.mark.parametrize("kwargs", [{"newline_sep": True}, {"decimal_places": True}])
+def test_rouge_deprecated_kwargs_warn(kwargs):
+    key = next(iter(kwargs))
+    with pytest.warns(UserWarning, match=f"`{key}` is deprecated in v0.6"):
+        ROUGEScore(**kwargs)
+
+
+def test_rouge_deprecated_kwargs_silent_when_unset():
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        ROUGEScore()
+
+
+def test_wer_concatenate_texts_warns_and_is_inert():
+    with pytest.warns(DeprecationWarning, match="`concatenate_texts` has been deprecated in v0.6"):
+        m = WER(concatenate_texts=True)
+    m.update(["hello world"], ["hello world"])
+    assert float(m.compute()) == 0.0
+
+
+def test_read_baseline_url_file_scheme(tmp_path):
+    """file:// URLs drive the same reader as HTTP — csv and tsv variants."""
+    src = bundled_baseline_path()
+    want = np.asarray(_read_baseline_csv(src))
+
+    got_csv = np.asarray(_read_baseline_url(f"file://{src}"))
+    np.testing.assert_array_equal(got_csv, want)
+
+    tsv = tmp_path / "baseline.tsv"
+    tsv.write_text(open(src).read().replace(",", "\t"))
+    got_tsv = np.asarray(_read_baseline_url(f"file://{tsv}"))
+    np.testing.assert_array_equal(got_tsv, want)
+
+
+def test_bertscore_baseline_url_end_to_end():
+    """BERTScore(baseline_url=file://...) rescales identically to the same
+    baseline passed via baseline_path."""
+    preds = ["the cat sat on the mat"] * 2
+    refs = ["a cat sat on a mat"] * 2
+    src = bundled_baseline_path()
+
+    by_url = BERTScore(max_length=32, rescale_with_baseline=True, baseline_url=f"file://{src}")
+    by_url.update(preds, refs)
+    out_url = by_url.compute()
+
+    by_path = BERTScore(max_length=32, rescale_with_baseline=True, baseline_path=src)
+    by_path.update(preds, refs)
+    out_path = by_path.compute()
+
+    for key in ("precision", "recall", "f1"):
+        np.testing.assert_allclose(np.asarray(out_url[key]), np.asarray(out_path[key]), atol=1e-7)
+
+
+def test_bertscore_bad_url_degrades_with_warning():
+    m = BERTScore(max_length=32, rescale_with_baseline=True,
+                  baseline_url="file:///nonexistent/baseline.tsv")
+    m.update(["hi there"], ["hi there"])
+    with pytest.warns(UserWarning, match="Baseline"):
+        out = m.compute()
+    assert np.isfinite(np.asarray(out["f1"])).all()
