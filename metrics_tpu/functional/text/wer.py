@@ -7,7 +7,7 @@ tokens are interned to int ids and each DP row is computed with a prefix-min
 scan instead of the reference's O(m·n) pure-Python double loop — and only the
 two scalar counters live on device.
 """
-from typing import List, Tuple, Union
+from typing import List, Optional, Tuple, Union
 
 import jax.numpy as jnp
 import numpy as np
@@ -69,12 +69,16 @@ def _wer_compute(errors: Array, total: Array) -> Array:
 def wer(
     predictions: Union[str, List[str]],
     references: Union[str, List[str]],
+    concatenate_texts: Optional[bool] = None,  # deprecated (reference v0.6); remove in v0.7
 ) -> Array:
     """Word error rate: ``(S + D + I) / N`` over all reference words.
 
     Args:
         predictions: transcription(s) to score.
         references: reference(s) for each input.
+        concatenate_texts: deprecated no-op, mirroring the reference
+            (`functional/text/wer.py:90-112`) — the counter accumulation is
+            equivalent either way; only the deprecation warning remains.
 
     Example:
         >>> predictions = ["this is the prediction", "there is an other sample"]
@@ -82,5 +86,12 @@ def wer(
         >>> float(wer(predictions=predictions, references=references))
         0.5
     """
+    if concatenate_texts is not None:
+        import warnings
+
+        warnings.warn(
+            "`concatenate_texts` has been deprecated in v0.6 and it will be removed in v0.7",
+            DeprecationWarning,
+        )
     errors, total = _wer_update(predictions, references)
     return _wer_compute(errors, total)

@@ -53,3 +53,37 @@ def test_every_reference_constructor_param_exists():
             problems.append(f"{name} lacks reference params {sorted(missing)}")
     assert checked >= 50, f"sweep degenerated: only {checked} classes compared"
     assert not problems, "\n".join(problems)
+
+
+@pytest.mark.skipif(not REF.exists(), reason="reference checkout not present")
+def test_every_reference_functional_param_exists():
+    import metrics_tpu.functional as ours
+
+    ref_sigs = {}
+    for p in (REF / "functional").rglob("*.py"):
+        try:
+            tree = ast.parse(p.read_text())
+        except SyntaxError:
+            continue
+        for node in tree.body:  # public top-level functions only
+            if isinstance(node, ast.FunctionDef) and not node.name.startswith("_"):
+                params = [a.arg for a in node.args.args]
+                params += [a.arg for a in node.args.kwonlyargs]
+                ref_sigs.setdefault(node.name, set()).update(params)
+
+    problems = []
+    checked = 0
+    for name in dir(ours):
+        fn = getattr(ours, name)
+        if not callable(fn) or inspect.isclass(fn) or name not in ref_sigs:
+            continue
+        try:
+            mine = set(inspect.signature(fn).parameters)
+        except (ValueError, TypeError):
+            continue
+        checked += 1
+        missing = ref_sigs[name] - mine - {"kwargs", "args"}
+        if missing:
+            problems.append(f"{name} lacks reference params {sorted(missing)}")
+    assert checked >= 50, f"sweep degenerated: only {checked} functions compared"
+    assert not problems, "\n".join(problems)
