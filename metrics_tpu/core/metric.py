@@ -640,6 +640,46 @@ class Metric:
         )
         return self
 
+    # -- device placement (reference ``metric.py:420-524`` to/cpu/cuda) ----
+    @property
+    def device(self) -> Any:
+        """Device holding the state (first array leaf's device; the default
+        jax device before the first update). Reference ``Metric.device``."""
+        for leaf in jax.tree_util.tree_leaves(self._state):
+            if isinstance(leaf, jnp.ndarray) and hasattr(leaf, "devices"):
+                devs = leaf.devices()
+                if devs:
+                    return next(iter(devs))
+        return jax.devices()[0]
+
+    def to(self, device: Any = None, dtype: Any = None) -> "Metric":
+        """Move state to ``device`` and/or cast floats to ``dtype``.
+
+        TPU-native analogue of the reference's ``to()`` (``metric.py:420``):
+        placement is ``jax.device_put`` over the state pytree — accepts a
+        ``jax.Device`` or a ``Sharding`` (mesh placement for sharded eval).
+        """
+        if dtype is not None:
+            self.set_dtype(dtype)
+        if device is not None:
+            self.to_device(device)
+        return self
+
+    def cpu(self) -> "Metric":
+        """Move state to the host CPU device (reference ``metric.py:441``)."""
+        return self.to(device=jax.devices("cpu")[0])
+
+    def cuda(self, device: Any = None) -> "Metric":
+        """torch-compat alias: place state on the accelerator. On TPU builds
+        this is the TPU chip (reference ``metric.py:445`` moves to GPU)."""
+        if device is None:
+            device = jax.devices()[0]
+        return self.to(device=device)
+
+    def type(self, dst_type: Any) -> "Metric":
+        """torch-compat alias for ``set_dtype`` (reference ``metric.py:495``)."""
+        return self.set_dtype(dst_type)
+
     def half(self) -> "Metric":
         """Cast floating state to float16 (reference nn.Module ``half()``)."""
         return self.set_dtype(jnp.float16)

@@ -87,3 +87,33 @@ def test_every_reference_functional_param_exists():
             problems.append(f"{name} lacks reference params {sorted(missing)}")
     assert checked >= 50, f"sweep degenerated: only {checked} functions compared"
     assert not problems, "\n".join(problems)
+
+
+@pytest.mark.skipif(not REF.exists(), reason="reference checkout not present")
+def test_every_reference_public_method_exists():
+    import metrics_tpu as ours
+
+    ref_methods = {}
+    for p in REF.rglob("*.py"):
+        try:
+            tree = ast.parse(p.read_text())
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, ast.FunctionDef) and not item.name.startswith("_"):
+                        ref_methods.setdefault(node.name, set()).add(item.name)
+
+    problems = []
+    checked = 0
+    for name in dir(ours):
+        cls = getattr(ours, name)
+        if not inspect.isclass(cls) or name not in ref_methods:
+            continue
+        checked += 1
+        missing = ref_methods[name] - set(dir(cls))
+        if missing:
+            problems.append(f"{name} lacks reference methods {sorted(missing)}")
+    assert checked >= 50, f"sweep degenerated: only {checked} classes compared"
+    assert not problems, "\n".join(problems)
