@@ -17,9 +17,10 @@
   :func:`metrics_tpu.models.bert.load_torch_bert_weights`. A custom model
   plugs in through ``user_forward_fn`` exactly like the reference's
   own-model example (``tm_examples/bert_score-own_model.py``).
-- **No HTTP.** Baseline rescaling reads a local csv/tsv (``baseline_path``)
-  or an explicit array; the reference's URL fetch (``bert.py:411-449``) has
-  no offline equivalent.
+- **Offline-first baselines.** Baseline rescaling reads a local csv/tsv
+  (``baseline_path``) or an explicit array; ``baseline_url`` keeps the
+  reference's URL fetch (``bert.py:411-449``) for connected machines, with
+  failures degrading to a warning instead of killing the scoring run.
 """
 import csv
 import math
@@ -294,7 +295,7 @@ def bert_score(
     device: Optional[Any] = None,
     max_length: int = 512,
     batch_size: int = 64,
-    num_threads: int = 0,
+    num_threads: int = 4,  # reference default; inert here (no host DataLoader pool)
     return_hash: bool = False,
     lang: str = "en",
     rescale_with_baseline: bool = False,

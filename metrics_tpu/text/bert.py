@@ -55,7 +55,7 @@ class BERTScore(Metric):
         device: Optional[Any] = None,
         max_length: int = 512,
         batch_size: int = 64,
-        num_threads: int = 0,
+        num_threads: int = 4,  # reference default; inert here (no host DataLoader pool)
         return_hash: bool = False,
         lang: str = "en",
         rescale_with_baseline: bool = False,
