@@ -148,3 +148,31 @@ def test_update_order_invariance(target, data):
     parts.update(jnp.asarray(p[split:]), jnp.asarray(t[split:]))
 
     np.testing.assert_allclose(float(whole.compute()), float(parts.compute()), atol=1e-6)
+
+
+@settings(**COMMON)
+@given(preds=_labels, target=_labels)
+def test_matthews_and_kappa_degenerate_confmats(preds, target):
+    """Matthews/Cohen-kappa vs sklearn on adversarial label streams —
+    degenerate confusion matrices (single-class predictions, empty rows)
+    are the division-by-zero minefield; sklearn returns 0.0 there."""
+    from sklearn.metrics import cohen_kappa_score, matthews_corrcoef as sk_mcc
+
+    from metrics_tpu.functional import cohen_kappa, matthews_corrcoef
+
+    p, t = np.asarray(preds), np.asarray(target)
+    got_mcc = float(matthews_corrcoef(jnp.asarray(p), jnp.asarray(t), num_classes=C))
+    if len(set(p.tolist())) == 1 or len(set(t.tolist())) == 1:
+        # constant preds or targets: the 0/0 case. The reference yields NaN
+        # (`functional/classification/matthews_corrcoef.py:38`) and we match
+        # it; sklearn substitutes 0.0 (later torchmetrics versions followed)
+        assert np.isnan(got_mcc)
+    else:
+        np.testing.assert_allclose(got_mcc, sk_mcc(t, p), atol=1e-5)
+
+    got_kappa = float(cohen_kappa(jnp.asarray(p), jnp.asarray(t), num_classes=C))
+    want_kappa = cohen_kappa_score(t, p)
+    if np.isnan(want_kappa):  # sklearn yields nan for a constant pair; we return it too
+        assert np.isnan(got_kappa)
+    else:
+        np.testing.assert_allclose(got_kappa, want_kappa, atol=1e-5)
