@@ -1,0 +1,260 @@
+"""Pearson / Spearman / CosineSimilarity / TweedieDeviance matrices.
+
+Mirrors the reference's `tests/regression/test_pearson.py` (two input
+distributions × ddp), `test_spearman.py` (three inputs incl. heavy ties ×
+ddp × per-step sync + scipy `rankdata` parity), `test_cosine_similarity.py`
+(single/multi-target × reduction × ddp × per-step sync) and
+`test_tweedie_deviance.py` (six powers × inputs × ddp × per-step sync +
+domain-error matrix), all against scipy/sklearn oracles.
+"""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.stats import pearsonr, rankdata, spearmanr
+from sklearn.metrics import mean_tweedie_deviance as sk_tweedie
+
+from metrics_tpu import (
+    CosineSimilarity,
+    PearsonCorrcoef,
+    SpearmanCorrcoef,
+    TweedieDevianceScore,
+)
+from metrics_tpu.functional import (
+    cosine_similarity,
+    pearson_corrcoef,
+    spearman_corrcoef,
+    tweedie_deviance_score,
+)
+from metrics_tpu.functional.regression.spearman import _rank_data
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+seed_all(42)
+
+NUM_TARGETS = 5
+rng = np.random.RandomState(42)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_uniform = Input(
+    preds=rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) * 0.9 + 0.05,
+    target=rng.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32) * 0.9 + 0.05,
+)
+_normal = Input(
+    preds=rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=rng.randn(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+)
+# heavy-tie fixture (reference test_spearman.py:39-42)
+_ties = Input(
+    preds=np.stack([np.asarray([1.0, 0.0, 4.0, 1.0, 0.0, 3.0, 0.0], np.float32)] * NUM_BATCHES),
+    target=np.stack([np.asarray([4.0, 0.0, 3.0, 3.0, 3.0, 1.0, 1.0], np.float32)] * NUM_BATCHES),
+)
+_multi = Input(
+    preds=rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_TARGETS).astype(np.float32) * 0.9 + 0.05,
+    target=rng.rand(NUM_BATCHES, BATCH_SIZE, NUM_TARGETS).astype(np.float32) * 0.9 + 0.05,
+)
+
+
+# ----------------------------------------------------------------- Pearson
+def _sk_pearson(preds, target):
+    return pearsonr(target.reshape(-1), preds.reshape(-1))[0]
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [(_uniform.preds, _uniform.target), (_normal.preds, _normal.target)],
+    ids=["uniform", "normal"],
+)
+class TestPearsonMatrix(MetricTester):
+    atol = 1e-3
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    def test_pearson_class(self, preds, target, ddp):
+        # per-step sync is not exercised for Pearson, matching the reference
+        # (test_pearson.py:56-65 fixes dist_sync_on_step=False): its states
+        # carry dist_reduce_fx=None and fold through the pairwise merge.
+        self.run_class_metric_test(
+            ddp=ddp, preds=preds, target=target, metric_class=PearsonCorrcoef,
+            sk_metric=_sk_pearson,
+        )
+
+    def test_pearson_functional(self, preds, target):
+        self.run_functional_metric_test(preds, target, pearson_corrcoef, _sk_pearson)
+
+    def test_pearson_differentiability(self, preds, target):
+        self.run_differentiability_test(
+            preds, target, metric_class=PearsonCorrcoef, metric_functional=pearson_corrcoef
+        )
+
+
+def test_pearson_error_on_different_shape():
+    metric = PearsonCorrcoef()
+    with pytest.raises(RuntimeError, match="same shape"):
+        metric(jnp.zeros(100), jnp.zeros(50))
+    with pytest.raises(ValueError, match="1 dimensional"):
+        metric(jnp.zeros((100, 2)), jnp.zeros((100, 2)))
+
+
+# ----------------------------------------------------------------- Spearman
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_uniform.preds, _uniform.target),
+        (_normal.preds, _normal.target),
+        (_ties.preds, _ties.target),
+    ],
+    ids=["uniform", "normal", "ties"],
+)
+def test_spearman_ranking_vs_scipy(preds, target):
+    """`_rank_data` must reproduce scipy.stats.rankdata tie-averaged ranks
+    (reference test_spearman.py:53-59)."""
+    for p, t in zip(preds, target):
+        np.testing.assert_array_equal(np.asarray(_rank_data(jnp.asarray(p))), rankdata(p))
+        np.testing.assert_array_equal(np.asarray(_rank_data(jnp.asarray(t))), rankdata(t))
+
+
+def _sk_spearman(preds, target):
+    return spearmanr(target.reshape(-1), preds.reshape(-1))[0]
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_uniform.preds, _uniform.target),
+        (_normal.preds, _normal.target),
+        (_ties.preds, _ties.target),
+    ],
+    ids=["uniform", "normal", "ties"],
+)
+class TestSpearmanMatrix(MetricTester):
+    atol = 1e-3
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_spearman_class(self, preds, target, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp, preds=preds, target=target, metric_class=SpearmanCorrcoef,
+            sk_metric=_sk_spearman, dist_sync_on_step=dist_sync_on_step,
+        )
+
+    def test_spearman_functional(self, preds, target):
+        self.run_functional_metric_test(preds, target, spearman_corrcoef, _sk_spearman)
+
+    def test_spearman_differentiability(self, preds, target):
+        self.run_differentiability_test(
+            preds, target, metric_class=SpearmanCorrcoef, metric_functional=spearman_corrcoef
+        )
+
+
+def test_spearman_error_on_different_shape():
+    metric = SpearmanCorrcoef()
+    with pytest.raises(RuntimeError, match="same shape"):
+        metric(jnp.zeros(100), jnp.zeros(50))
+    with pytest.raises(ValueError, match="1 dimensional"):
+        metric(jnp.zeros((100, 2)), jnp.zeros((100, 2)))
+
+
+# --------------------------------------------------------- CosineSimilarity
+def _sk_cosine(preds, target, reduction):
+    # 1-D input is a single vector; N-D is rows of last-dim vectors
+    p = preds.reshape(1, -1) if preds.ndim == 1 else preds.reshape(-1, preds.shape[-1])
+    t = target.reshape(1, -1) if target.ndim == 1 else target.reshape(-1, target.shape[-1])
+    sim = (p * t).sum(-1) / (np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1))
+    return {"sum": sim.sum(), "mean": sim.mean(), "none": sim}[reduction]
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean"])
+@pytest.mark.parametrize(
+    "preds, target",
+    [(_uniform.preds, _uniform.target), (_multi.preds, _multi.target)],
+    ids=["single_target", "multi_target"],
+)
+class TestCosineSimilarityMatrix(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_cosine_class(self, reduction, preds, target, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp, preds=preds, target=target, metric_class=CosineSimilarity,
+            sk_metric=partial(_sk_cosine, reduction=reduction),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args=dict(reduction=reduction),
+        )
+
+    def test_cosine_functional(self, reduction, preds, target):
+        self.run_functional_metric_test(
+            preds, target, cosine_similarity,
+            partial(_sk_cosine, reduction=reduction),
+            metric_args=dict(reduction=reduction),
+        )
+
+
+def test_cosine_invalid_reduction():
+    with pytest.raises(ValueError, match="Expected reduction to be one of"):
+        cosine_similarity(jnp.ones((4, 3)), jnp.ones((4, 3)), reduction="bogus")
+
+
+# ----------------------------------------------------------------- Tweedie
+def _sk_deviance(preds, target, power):
+    return sk_tweedie(target.reshape(-1), preds.reshape(-1), power=power)
+
+
+@pytest.mark.parametrize("power", [-0.5, 0, 1, 1.5, 2, 3])
+@pytest.mark.parametrize(
+    "preds, target",
+    [(_uniform.preds, _uniform.target), (_multi.preds, _multi.target)],
+    ids=["single_target", "multi_target"],
+)
+class TestTweedieDevianceMatrix(MetricTester):
+    atol = 1e-4
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_tweedie_class(self, power, preds, target, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp, preds=preds, target=target, metric_class=TweedieDevianceScore,
+            sk_metric=partial(_sk_deviance, power=power),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args=dict(power=power),
+        )
+
+    def test_tweedie_functional(self, power, preds, target):
+        self.run_functional_metric_test(
+            preds, target, tweedie_deviance_score,
+            partial(_sk_deviance, power=power),
+            metric_args=dict(power=power),
+        )
+
+    def test_tweedie_differentiability(self, power, preds, target):
+        self.run_differentiability_test(
+            preds, target, metric_class=TweedieDevianceScore,
+            metric_functional=tweedie_deviance_score, metric_args=dict(power=power),
+        )
+
+
+def test_tweedie_error_on_different_shape():
+    metric = TweedieDevianceScore()
+    with pytest.raises(RuntimeError, match="same shape"):
+        metric(jnp.ones(100), jnp.ones(50))
+
+
+def test_tweedie_error_on_invalid_inputs():
+    """Domain-error matrix (reference test_tweedie_deviance.py:120-141)."""
+    with pytest.raises(ValueError, match="Deviance Score is not defined for power=0.5."):
+        TweedieDevianceScore(power=0.5)
+
+    metric = TweedieDevianceScore(power=1)
+    with pytest.raises(ValueError, match="strictly positive"):
+        metric(jnp.asarray([-1.0, 2.0, 3.0]), jnp.asarray([1.0, 2.0, 3.0]))
+    with pytest.raises(ValueError, match="cannot be negative"):
+        metric(jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([-1.0, 2.0, 3.0]))
+
+    metric = TweedieDevianceScore(power=2)
+    with pytest.raises(ValueError, match="strictly positive"):
+        metric(jnp.asarray([-1.0, 2.0, 3.0]), jnp.asarray([1.0, 2.0, 3.0]))
+    with pytest.raises(ValueError, match="strictly positive"):
+        metric(jnp.asarray([1.0, 2.0, 3.0]), jnp.asarray([-1.0, 2.0, 3.0]))
