@@ -1,0 +1,28 @@
+"""Shared axon-tunnel probe for the hardware-evidence scripts.
+
+jax.devices() against a dead axon tunnel blocks forever in-process
+(probe_log.txt is a museum of such hangs), so the probe runs in a killable
+subprocess with an external timeout.
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+
+PROBE_TIMEOUT_S = 75
+
+
+def probe_tunnel(timeout_s: int = PROBE_TIMEOUT_S) -> bool:
+    """True when the TPU backend answers within ``timeout_s``; never hangs."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; print('OK', jax.devices()[0])"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        print(f"backend probe hung ({timeout_s}s) — tunnel dead", file=sys.stderr)
+        return False
+    if r.returncode != 0 or "OK" not in r.stdout:
+        print(f"backend probe failed: {(r.stdout + r.stderr)[-300:]}", file=sys.stderr)
+        return False
+    return True

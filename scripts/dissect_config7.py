@@ -1,0 +1,72 @@
+"""Attribute bench config-7's metric overhead per component on real TPU.
+
+The first config-7 run (2026-07-31 11:41Z window) measured 68.6% overhead
+against the <1% BASELINE.md target; this dissection showed every component's
+marginal cost sits at the noise floor, which led to the interleaved
+`_time_scan_step_pair` methodology now used by `bench_config7` (0.94%
+direct). Kept as a diagnostic: it reruns the same scan-slope timing with
+each metric enabled in isolation:
+
+    fwd_only | +fid | +acc | +auroc | +all
+
+The step functions come from `bench.build_config7_loop()` — shared with
+`bench_config7` so the attribution always measures the bench's exact
+computation. Appends one JSON line to scripts/dissect_config7.log.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def main() -> int:
+    from _tunnel import probe_tunnel
+
+    if not probe_tunnel():
+        return 2
+
+    import jax
+
+    from bench import _time_scan_step, build_config7_loop
+    from metrics_tpu.utils import compile_cache
+
+    compile_cache.enable(str(Path(__file__).resolve().parent.parent / ".jax_cache"), min_compile_seconds=2)
+
+    cfg = build_config7_loop()
+    make_step, state0, k1, k2 = cfg["make_step"], cfg["state0"], cfg["k1"], cfg["k2"]
+
+    variants = {
+        "fwd_only": (False, False, False),
+        "fid": (True, False, False),
+        "acc": (False, True, False),
+        "auroc": (False, False, True),
+        "all": (True, True, True),
+    }
+    out = {"metric": "config7_dissection", "platform": jax.default_backend(),
+           "batch": cfg["batch"], "img_px": cfg["img_px"], "steps": {}}
+    for name, flags in variants.items():
+        per_step, compile_s, resolution, _ = _time_scan_step(make_step(*flags), state0, k1=k1, k2=k2)
+        per_step = max(per_step, resolution)
+        out["steps"][name] = {"ms": round(per_step * 1e3, 3), "compile_s": round(compile_s, 1),
+                              "resolution_ms": round(resolution * 1e3, 3)}
+        print(f"{name}: {per_step * 1e3:.3f} ms/step (compile {compile_s:.0f}s)", file=sys.stderr)
+
+    base = out["steps"]["fwd_only"]["ms"]
+    for name in ("fid", "acc", "auroc", "all"):
+        out["steps"][name]["overhead_pct"] = round(
+            max(out["steps"][name]["ms"] - base, 0.0) / base * 100.0, 2
+        )
+    out["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    line = json.dumps(out)
+    print(line)
+    with Path(__file__).with_name("dissect_config7.log").open("a") as f:
+        f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# runnable as `python scripts/pallas_tpu_proof.py`: the script dir, not the
+# repo root, lands on sys.path, so metrics_tpu would be unimportable
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
 
 def _median_time(fn, *args, reps: int = 20) -> float:
     # end every rep with a data-dependent device->host scalar fetch:
@@ -34,21 +38,9 @@ def _median_time(fn, *args, reps: int = 20) -> float:
 
 
 def main() -> int:
-    # probe the tunnel in a killable subprocess first: jax.devices() against a
-    # dead axon tunnel blocks forever in-process (probe_log.txt is a museum of
-    # such hangs), and only the watchdog's external timeout would save us
-    import subprocess
+    from _tunnel import probe_tunnel
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", "import jax; print('OK', jax.devices()[0])"],
-            capture_output=True, text=True, timeout=75,
-        )
-    except subprocess.TimeoutExpired:
-        print("backend probe hung (75s) — tunnel dead", file=sys.stderr)
-        return 2
-    if r.returncode != 0 or "OK" not in r.stdout:
-        print(f"backend probe failed: {(r.stdout + r.stderr)[-300:]}", file=sys.stderr)
+    if not probe_tunnel():
         return 2
 
     from metrics_tpu.utils import compile_cache

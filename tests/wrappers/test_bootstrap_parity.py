@@ -1,0 +1,84 @@
+"""Per-bootstrap exact parity vs sklearn on captured resamples.
+
+Mirror of the reference's `tests/wrappers/test_bootstrapping.py:86-123`:
+subclass BootStrapper to capture the exact resampled inputs each copy
+receives, accumulate over batches, then assert each copy's compute equals
+sklearn on its own resampled stream, and that mean/std/quantile/raw are the
+matching numpy reductions over the per-copy scores.
+"""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import mean_squared_error, precision_score, recall_score
+
+from metrics_tpu import MeanSquaredError, Precision, Recall
+from metrics_tpu.wrappers.bootstrapping import BootStrapper, _bootstrap_sampler
+from metrics_tpu.utils.data import apply_to_collection
+
+NUM_BATCHES, BATCH = 10, 32
+rng = np.random.RandomState(42)
+_preds_cls = rng.randint(0, 10, (NUM_BATCHES, BATCH))
+_target_cls = rng.randint(0, 10, (NUM_BATCHES, BATCH))
+_preds_reg = rng.rand(NUM_BATCHES, BATCH).astype(np.float32)
+_target_reg = rng.rand(NUM_BATCHES, BATCH).astype(np.float32)
+
+
+class _CapturingBootStrapper(BootStrapper):
+    """Capture the resampled args each bootstrap copy receives (reference
+    TestBootStrapper, test_bootstrapping.py:35-46)."""
+
+    def update(self, *args):
+        import jax
+
+        self.out = []
+        size = len(args[0])
+        for idx in range(self.num_bootstraps):
+            self._key, subkey = jax.random.split(self._key)
+            sample_idx = _bootstrap_sampler(subkey, size, sampling_strategy=self.sampling_strategy)
+            new_args = apply_to_collection(args, jnp.ndarray, lambda x: jnp.take(x, sample_idx, axis=0))
+            self.metrics[idx].update(*new_args)
+            self.out.append(new_args)
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+@pytest.mark.parametrize(
+    "metric_ctor, sk_metric, preds, target",
+    [
+        (partial(Precision, num_classes=10, average="micro"),
+         partial(precision_score, average="micro"), _preds_cls, _target_cls),
+        (partial(Recall, num_classes=10, average="micro"),
+         partial(recall_score, average="micro"), _preds_cls, _target_cls),
+        (MeanSquaredError, mean_squared_error, _preds_reg, _target_reg),
+    ],
+    ids=["precision_micro", "recall_micro", "mse"],
+)
+def test_bootstrap_per_copy_parity(sampling_strategy, metric_ctor, sk_metric, preds, target):
+    boot = _CapturingBootStrapper(
+        metric_ctor(), num_bootstraps=5, mean=True, std=True, raw=True,
+        quantile=jnp.asarray([0.05, 0.95]), sampling_strategy=sampling_strategy, seed=7,
+    )
+
+    collected_p = [[] for _ in range(boot.num_bootstraps)]
+    collected_t = [[] for _ in range(boot.num_bootstraps)]
+    for p, t in zip(preds, target):
+        boot.update(jnp.asarray(p), jnp.asarray(t))
+        for i, (rp, rt) in enumerate(boot.out):
+            collected_p[i].append(np.asarray(rp))
+            collected_t[i].append(np.asarray(rt))
+
+    sk_scores = [
+        sk_metric(np.concatenate(ct), np.concatenate(cp))
+        for cp, ct in zip(collected_p, collected_t)
+    ]
+
+    out = boot.compute()
+    np.testing.assert_allclose(np.asarray(out["raw"]), sk_scores, atol=1e-5)
+    np.testing.assert_allclose(float(out["mean"]), np.mean(sk_scores), atol=1e-5)
+    np.testing.assert_allclose(float(out["std"]), np.std(sk_scores, ddof=1), atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out["quantile"]),
+        [np.quantile(sk_scores, 0.05), np.quantile(sk_scores, 0.95)],
+        atol=1e-5,
+    )
