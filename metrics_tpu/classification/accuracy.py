@@ -24,7 +24,39 @@ from metrics_tpu.utils.enums import DataType
 
 
 class Accuracy(StatScores):
-    r"""Accuracy :math:`\frac{1}{N}\sum_i^N 1(y_i = \hat{y}_i)`.
+    r"""Accuracy :math:`\frac{1}{N}\sum_i^N 1(y_i = \hat{y}_i)` — fraction
+    of predictions that hit their target.
+
+    Works for every classification input form (binary / multiclass /
+    multilabel / multidim, labels or probabilities — detected eagerly once,
+    then static under jit) and accumulates on the shared
+    :class:`StatScores` counters, plus dedicated ``correct``/``total`` sum
+    states for the subset and top-k paths.
+
+    Args:
+        threshold: binarization cut for binary/multilabel probabilities.
+        num_classes: number of classes; required for per-class averages
+            (``"macro"``/``"weighted"``/``"none"``).
+        average: reduction across classes — ``"micro"`` pools every
+            decision; ``"macro"``/``"weighted"``/``"samples"``/``"none"``
+            as documented on :class:`~metrics_tpu.Precision`.
+        mdmc_average: multidim handling; unlike the other StatScores
+            metrics this defaults to ``"global"`` (flatten the extra
+            dimension) so plain segmentation-style input works out of the
+            box. ``"samplewise"`` averages per-sample scores instead.
+        ignore_index: class label excluded from scoring.
+        top_k: with multiclass probabilities, count a hit when the target
+            is among the k best-scored classes.
+        multiclass: force/forbid multiclass interpretation.
+        subset_accuracy: for multilabel/multidim input, score a sample 1
+            only when EVERY label of that sample is right (exact-match
+            accuracy) instead of scoring labels independently.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    Raises:
+        ValueError: unknown ``average``, per-class average without
+            ``num_classes``, or non-positive ``top_k``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -33,6 +65,10 @@ class Accuracy(StatScores):
         >>> preds = jnp.asarray([0, 2, 1, 3])
         >>> accuracy = Accuracy(num_classes=4)
         >>> print(round(float(accuracy(preds, target)), 4))
+        0.5
+        >>> probs = jnp.asarray([[0.1, 0.5, 0.3, 0.1], [0.4, 0.1, 0.3, 0.2]])
+        >>> top2 = Accuracy(top_k=2)
+        >>> print(round(float(top2(probs, jnp.asarray([2, 3]))), 4))
         0.5
     """
 

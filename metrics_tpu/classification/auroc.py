@@ -20,7 +20,39 @@ from metrics_tpu.utils.enums import AverageMethod, DataType
 
 
 class AUROC(Metric):
-    """Area under the ROC curve, accumulated over batches via cat-states.
+    r"""Area under the ROC curve — the probability a random positive scores
+    above a random negative (reference ``auroc.py``).
+
+    Scores and targets accumulate across batches as "cat" states
+    (``all_gather`` across the mesh at sync); the curve and its area are
+    only formed at :meth:`compute`. Two accumulation layouts:
+
+    - default: python list-of-batches (re-traces as it grows; fully
+      flexible sizes);
+    - :meth:`~metrics_tpu.core.metric.Metric.with_capacity`: a fixed-size
+      on-device :class:`~metrics_tpu.CatBuffer` ring, making update a
+      constant-shape ``dynamic_update_slice`` that stays inside one jitted
+      step (the form the bench's eval loops use). Compute then uses
+      masked Mann–Whitney ranking (``ops/ranking.py``) so padding rows
+      never touch the statistic.
+
+    Args:
+        num_classes: number of classes for multiclass scores ``[N, C]``;
+            leave ``None`` for binary ``[N]`` scores.
+        pos_label: which label counts as positive for binary input
+            (default 1).
+        average: multiclass/multilabel reduction — ``"macro"`` averages
+            per-class AUROCs, ``"weighted"`` weights them by support,
+            ``"micro"`` pools all decisions (multilabel only), ``None``
+            returns the per-class vector.
+        max_fpr: integrate only up to this false-positive rate and rescale
+            by the McClish correction (binary only).
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    Raises:
+        ValueError: unknown ``average``, ``max_fpr`` outside ``(0, 1]``,
+            or multiclass input without ``num_classes``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -30,6 +62,10 @@ class AUROC(Metric):
         >>> auroc = AUROC()
         >>> print(round(float(auroc(preds, target)), 4))
         0.75
+        >>> multi = AUROC(num_classes=3)
+        >>> scores = jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1], [0.2, 0.2, 0.6]])
+        >>> print(round(float(multi(scores, jnp.asarray([0, 1, 2]))), 4))
+        1.0
     """
 
     is_differentiable = False

@@ -22,7 +22,25 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class AveragePrecision(Metric):
-    """Area under the precision-recall step curve, over accumulated batches.
+    r"""Average precision :math:`\sum_k (R_k - R_{k-1}) P_k` — the area
+    under the precision–recall step curve (reference
+    ``average_precision.py``). Favoured over ROC-AUC when positives are
+    rare, because it never rewards easy true negatives.
+
+    Scores/targets accumulate as "cat" states (list-of-batches by
+    default, or a fixed-capacity :class:`~metrics_tpu.CatBuffer` via
+    ``with_capacity`` for a constant-shape jitted update; padding rows
+    are masked out of the ranking at compute).
+
+    Args:
+        num_classes: number of classes for multiclass scores ``[N, C]``;
+            ``None`` for binary ``[N]``.
+        pos_label: the label treated as positive in binary input.
+        average: ``"macro"`` (equal-weight mean of per-class APs),
+            ``"weighted"`` (support-weighted mean), ``"micro"`` (pool all
+            decisions), or ``None`` (per-class list).
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
 
     Example:
         >>> import jax.numpy as jnp

@@ -16,7 +16,22 @@ from metrics_tpu.functional.classification.cohen_kappa import (
 
 
 class CohenKappa(Metric):
-    r"""Cohen's kappa from an accumulated confusion matrix.
+    r"""Cohen's kappa :math:`\kappa = \frac{p_o - p_e}{1 - p_e}` —
+    agreement between predictions and targets, discounted by the
+    agreement ``p_e`` two independent raters with the same marginals
+    would reach by chance. 1 is perfect, 0 is chance level, negative is
+    systematic disagreement.
+
+    Runs on a constant-memory ``[C, C]`` confusion-matrix sum state.
+
+    Args:
+        num_classes: number of classes (sets the static state shape).
+        weights: ``None`` for plain kappa; ``"linear"``/``"quadratic"``
+            penalize disagreements by (squared) label distance — the
+            form used for ordinal labels.
+        threshold: binarization cut for probabilistic input.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
 
     Example:
         >>> import jax.numpy as jnp

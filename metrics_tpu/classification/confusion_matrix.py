@@ -17,7 +17,30 @@ from metrics_tpu.functional.classification.confusion_matrix import (
 
 
 class ConfusionMatrix(Metric):
-    """Confusion matrix with optional 'true'/'pred'/'all' normalization.
+    """The ``[C, C]`` count matrix — rows are true classes, columns
+    predicted classes (reference ``confusion_matrix.py``); with
+    ``multilabel=True`` a per-label ``[C, 2, 2]`` stack instead.
+
+    The running state is the matrix itself (a "sum" leaf — one ``psum``
+    across the mesh), filled per batch with a one-hot scatter-add, so
+    memory is constant in the number of samples.
+
+    Args:
+        num_classes: number of classes ``C`` (mandatory — sets the static
+            state shape).
+        normalize: divide counts at compute: ``"true"`` by row sums (each
+            row shows where that class's samples went), ``"pred"`` by
+            column sums, ``"all"`` by the grand total; ``None`` keeps raw
+            counts.
+        threshold: binarization cut for probabilistic binary/multilabel
+            input.
+        multilabel: treat input as independent per-label binary decisions
+            and return one 2×2 matrix per label.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    Raises:
+        ValueError: unknown ``normalize`` option.
 
     Example:
         >>> import jax.numpy as jnp

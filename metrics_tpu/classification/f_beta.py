@@ -12,7 +12,41 @@ from metrics_tpu.functional.classification.f_beta import _fbeta_compute
 
 
 class FBeta(StatScores):
-    r"""F-beta score, weighting recall by ``beta`` (reference ``f_beta.py:29``).
+    r"""F-beta score (reference ``f_beta.py:29``):
+
+    .. math::
+        F_\beta = (1 + \beta^2) \cdot
+            \frac{\text{precision} \cdot \text{recall}}
+                 {\beta^2 \cdot \text{precision} + \text{recall}}
+
+    ``beta < 1`` leans toward precision, ``beta > 1`` toward recall,
+    ``beta = 1`` is the harmonic mean (:class:`F1`). Runs on the shared
+    :class:`StatScores` tp/fp/tn/fn counters, so state stays four integers
+    per class however many batches stream through.
+
+    Args:
+        num_classes: number of classes; required for per-class averages
+            (``"macro"``/``"weighted"``/``"none"``).
+        beta: the precision/recall trade-off exponent above.
+        threshold: binarization cut for binary/multilabel probabilities.
+        average: ``"micro"`` (pool all decisions), ``"macro"`` (equal-weight
+            class mean), ``"weighted"`` (support-weighted class mean),
+            ``"samples"`` (per-sample then mean), ``"none"``/``None``
+            (return the per-class vector). Semantics as on
+            :class:`~metrics_tpu.Precision`.
+        mdmc_average: ``"global"``/``"samplewise"``/``None`` — how an extra
+            sample dimension folds in; see :class:`~metrics_tpu.Precision`.
+        ignore_index: class label excluded from all counters.
+        top_k: multiclass scores count a hit when the target is among the
+            top-k classes.
+        multiclass: force/forbid multiclass interpretation of ambiguous
+            inputs.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    Raises:
+        ValueError: unknown ``average``, per-class average without
+            ``num_classes``, or multidim input without ``mdmc_average``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -70,7 +104,9 @@ class FBeta(StatScores):
 
 
 class F1(FBeta):
-    r"""F1 = F-beta with beta=1 (reference ``f_beta.py:181``).
+    r"""F1 — the harmonic mean of precision and recall; :class:`FBeta` with
+    ``beta = 1`` (reference ``f_beta.py:181``). All arguments behave as
+    documented on :class:`FBeta`.
 
     Example:
         >>> import jax.numpy as jnp

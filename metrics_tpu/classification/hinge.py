@@ -17,7 +17,26 @@ from metrics_tpu.functional.classification.hinge import (
 
 
 class Hinge(Metric):
-    r"""Mean hinge loss for binary, Crammer-Singer or one-vs-all inputs.
+    r"""Mean hinge loss :math:`\max(0, 1 - y \cdot \hat{y})` over the
+    stream (sum + count states; one ``psum`` pair across the mesh).
+
+    Binary input takes raw decision values ``[N]`` against targets
+    {0, 1} (mapped to ±1 internally). Multiclass input ``[N, C]`` picks
+    its margin per ``multiclass_mode``:
+
+    - ``None`` / ``"crammer-singer"``: margin of the true class against
+      the best wrong class (multiclass SVM loss);
+    - ``"one-vs-all"``: one binary hinge per class, returned as ``[C]``.
+
+    Args:
+        squared: square each per-sample loss before averaging.
+        multiclass_mode: see above.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    Raises:
+        ValueError: unknown ``multiclass_mode``, or target values outside
+            the expected label set.
 
     Example:
         >>> import jax.numpy as jnp

@@ -12,7 +12,20 @@ from metrics_tpu.functional.classification.iou import _iou_from_confmat
 
 
 class IoU(ConfusionMatrix):
-    r"""Jaccard index from an accumulated confusion matrix.
+    r"""Intersection-over-union (Jaccard index)
+    :math:`\frac{TP}{TP + FP + FN}` per class, read off an accumulated
+    confusion matrix — diagonal over (row sum + column sum − diagonal).
+
+    Inherits :class:`ConfusionMatrix`'s constant-memory ``[C, C]`` sum
+    state and all its constructor arguments, adding:
+
+    Args:
+        ignore_index: class excluded from the final mean (its row/column
+            still counts toward other classes' unions).
+        absent_score: value a class contributes when it never occurs in
+            either preds or target (0/0 union).
+        reduction: ``"elementwise_mean"`` (default), ``"sum"``, or
+            ``"none"`` for the per-class vector.
 
     Example:
         >>> import jax.numpy as jnp

@@ -54,7 +54,55 @@ class _PrecisionRecallBase(StatScores):
 
 
 class Precision(_PrecisionRecallBase):
-    r"""Precision :math:`\frac{TP}{TP + FP}` (reference ``precision_recall.py:28``).
+    r"""Precision :math:`\frac{TP}{TP + FP}` — how much of what the model
+    flagged positive actually was positive (reference
+    ``precision_recall.py:28``).
+
+    Accumulates the tp/fp/tn/fn counters of :class:`StatScores` across
+    batches on-device and reduces them at :meth:`compute`, so the running
+    state is four integers per class regardless of dataset size.
+
+    Accepted input forms (auto-detected on the first eager update; the
+    detected form is then static for jit):
+
+    - binary labels or probabilities, shape ``[N]``
+    - multiclass labels ``[N]`` (int) or per-class scores ``[N, C]``
+    - multilabel probabilities ``[N, C]``
+    - multidimensional multiclass ``[N, ...]`` / ``[N, C, ...]`` — requires
+      ``mdmc_average`` to say how the extra dimension folds in
+
+    Args:
+        num_classes: number of classes ``C``. Mandatory whenever the result
+            is per-class (``average`` of ``"macro"``/``"weighted"``/
+            ``"none"``).
+        threshold: probability/logit cut for binarizing probabilistic
+            inputs (applied to binary and multilabel scores).
+        average: how per-class statistics collapse into the result —
+            ``"micro"`` pools all decisions into one tp/fp count before
+            dividing; ``"macro"`` averages per-class scores equally;
+            ``"weighted"`` weights per-class scores by class support;
+            ``"samples"`` scores each sample and averages over samples;
+            ``"none"``/``None`` returns the ``[C]`` vector unreduced.
+        mdmc_average: policy for inputs with an extra sample dimension:
+            ``"global"`` flattens the extra dimension into the batch before
+            counting; ``"samplewise"`` computes the metric per sample and
+            averages; ``None`` (default) rejects multidim input.
+        ignore_index: a class label excluded from every counter (rows whose
+            target carries this label contribute nothing).
+        top_k: for multiclass score inputs, count a hit if the target is in
+            the k highest-scoring classes (default: argmax only).
+        multiclass: force (True) or forbid (False) treating ambiguous
+            inputs as multiclass, overriding detection.
+        compute_on_step: return the batch-local value from ``forward``.
+        dist_sync_on_step: all-reduce the counters on every step, not only
+            at ``compute`` (useful when logging per-step global values).
+        process_group: mesh axis name(s) the sync collectives run over.
+        dist_sync_fn: override the gather used by the host-level sync path.
+
+    Raises:
+        ValueError: for an unknown ``average``, a per-class ``average``
+            without ``num_classes``, multidim input without
+            ``mdmc_average``, or inconsistent shapes.
 
     Example:
         >>> import jax.numpy as jnp
@@ -64,6 +112,10 @@ class Precision(_PrecisionRecallBase):
         >>> precision = Precision(num_classes=4, average="macro")
         >>> print(round(float(precision(preds, target)), 4))
         0.5
+        >>> micro = Precision(average="micro")
+        >>> micro.update(jnp.asarray([0.2, 0.8, 0.6]), jnp.asarray([0, 1, 0]))
+        >>> print(round(float(micro.compute()), 4))
+        0.5
     """
 
     def compute(self) -> Array:
@@ -72,7 +124,12 @@ class Precision(_PrecisionRecallBase):
 
 
 class Recall(_PrecisionRecallBase):
-    r"""Recall :math:`\frac{TP}{TP + FN}` (reference ``precision_recall.py:180``).
+    r"""Recall :math:`\frac{TP}{TP + FN}` — how much of what *is* positive
+    the model recovered (reference ``precision_recall.py:180``).
+
+    State, input handling, and every constructor argument behave exactly as
+    documented on :class:`Precision`; only the compute-time ratio differs
+    (false negatives in the denominator instead of false positives).
 
     Example:
         >>> import jax.numpy as jnp
@@ -82,6 +139,10 @@ class Recall(_PrecisionRecallBase):
         >>> recall = Recall(num_classes=4, average="macro")
         >>> print(round(float(recall(preds, target)), 4))
         0.5
+        >>> weighted = Recall(num_classes=3, average="weighted")
+        >>> weighted.update(jnp.asarray([0, 1, 1, 2]), jnp.asarray([0, 1, 2, 2]))
+        >>> print(round(float(weighted.compute()), 4))
+        0.75
     """
 
     def compute(self) -> Array:

@@ -13,7 +13,23 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class ROC(Metric):
-    """(fpr, tpr, thresholds) over all distinct thresholds.
+    """Full receiver-operating-characteristic curve: ``(fpr, tpr,
+    thresholds)`` at every distinct score (reference ``roc.py``).
+
+    Scores/targets accumulate as "cat" states; :meth:`compute` sorts once
+    and cumulative-sums (the XLA-friendly `_binary_clf_curve`), prepending
+    the conventional (0, 0) point. Binary input ``[N]`` yields three
+    arrays; multiclass ``[N, C]`` (with ``num_classes``) yields
+    per-class lists. For a constant-memory alternative with fixed
+    thresholds, see :class:`~metrics_tpu.BinnedPrecisionRecallCurve` — on
+    TPU it is the recommended default for large streams.
+
+    Args:
+        num_classes: number of classes for multiclass scores; ``None``
+            for binary.
+        pos_label: the label treated as positive in binary input.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
 
     Example:
         >>> import jax.numpy as jnp

@@ -12,7 +12,21 @@ from metrics_tpu.functional.classification.specificity import _specificity_compu
 
 
 class Specificity(StatScores):
-    r"""Specificity :math:`\frac{TN}{TN + FP}` (reference ``specificity.py:28``).
+    r"""Specificity :math:`\frac{TN}{TN + FP}` — the true-negative rate:
+    how much of what is *negative* the model correctly left alone
+    (reference ``specificity.py:28``). The mirror image of
+    :class:`~metrics_tpu.Recall`, which scores the positives.
+
+    Accumulates the shared :class:`StatScores` tp/fp/tn/fn counters;
+    every constructor argument (``num_classes``, ``threshold``,
+    ``average``, ``mdmc_average``, ``ignore_index``, ``top_k``,
+    ``multiclass``, and the runtime quartet) behaves exactly as documented
+    on :class:`~metrics_tpu.Precision` — only the compute-time ratio
+    differs, dividing true negatives by all actual negatives.
+
+    Raises:
+        ValueError: unknown ``average``, per-class average without
+            ``num_classes``, or multidim input without ``mdmc_average``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -22,6 +36,10 @@ class Specificity(StatScores):
         >>> specificity = Specificity(num_classes=4, average="macro")
         >>> print(round(float(specificity(preds, target)), 4))
         0.8333
+        >>> micro = Specificity(average="micro")
+        >>> micro.update(jnp.asarray([0.1, 0.9, 0.6]), jnp.asarray([0, 0, 1]))
+        >>> print(round(float(micro.compute()), 4))
+        0.5
     """
 
     is_differentiable = False

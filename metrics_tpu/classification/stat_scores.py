@@ -21,7 +21,43 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class StatScores(Metric):
-    """Computes the number of true/false positives/negatives and support.
+    """True/false positives/negatives and support — the shared accumulator
+    the whole Accuracy/Precision/Recall/F-beta/Specificity/Hamming family
+    derives from.
+
+    Each update formats the inputs into a canonical binary ``[N, C]`` (or
+    ``[N, C, X]``) layout and adds boolean-sum counters; states are int32
+    "sum" leaves (``psum`` across the mesh), except under
+    ``reduce="samples"`` / ``mdmc_reduce="samplewise"`` where per-batch
+    arrays accumulate as "cat" states (``all_gather`` across the mesh).
+
+    Args:
+        threshold: binarization cut for binary/multilabel probabilities.
+        top_k: with multiclass probabilities, one-hot the k best classes
+            instead of the argmax only.
+        reduce: counter granularity — ``"micro"`` keeps one global
+            tp/fp/tn/fn quartet; ``"macro"`` keeps a ``[C]`` quartet per
+            class; ``"samples"`` keeps a quartet per sample.
+        num_classes: number of classes ``C``; required for ``"macro"``.
+        ignore_index: class label whose rows/columns drop out of every
+            counter.
+        mdmc_reduce: multidim policy — ``"global"`` flattens the extra
+            sample dimension into the batch, ``"samplewise"`` keeps a
+            counter row per sample, ``None`` rejects multidim input.
+        multiclass: force/forbid multiclass interpretation of ambiguous
+            inputs (e.g. binary-looking int preds with ``num_classes=2``).
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    :meth:`compute` returns the counters stacked along the last axis in
+    the order ``[tp, fp, tn, fn, support]`` (support = tp + fn), shaped by
+    ``reduce``/``mdmc_reduce`` — e.g. ``[5]`` for micro, ``[C, 5]`` for
+    macro, ``[N, 5]`` for samplewise.
+
+    Raises:
+        ValueError: unknown ``reduce``/``mdmc_reduce``, ``"macro"`` without
+            ``num_classes``, multidim input without ``mdmc_reduce``, or a
+            ``threshold`` outside ``(0, 1)``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -31,6 +67,9 @@ class StatScores(Metric):
         >>> stat_scores = StatScores(reduce="micro", num_classes=2)
         >>> print(stat_scores(preds, target).tolist())  # tp, fp, tn, fn, support
         [3, 1, 3, 1, 4]
+        >>> per_class = StatScores(reduce="macro", num_classes=2)
+        >>> print(per_class(preds, target).tolist())
+        [[1, 0, 2, 1, 2], [2, 1, 1, 0, 2]]
     """
 
     is_differentiable = False

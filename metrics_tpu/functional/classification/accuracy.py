@@ -156,11 +156,22 @@ def accuracy(
     multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
-    r"""Accuracy :math:`\frac{1}{N}\sum_i^N 1(y_i = \hat{y}_i)`.
+    r"""Accuracy :math:`\frac{1}{N}\sum_i^N 1(y_i = \hat{y}_i)` in one
+    stateless call — contract identical to the reference's functional
+    ``accuracy`` (``functional/classification/accuracy.py:256-418``).
 
-    Contract identical to the reference's functional ``accuracy``
-    (``functional/classification/accuracy.py:256-418``); accepts all input
-    types, supports top-k and subset accuracy.
+    Accepts every classification input form (binary / multiclass /
+    multilabel / multidim; labels, probabilities, or logits). The shared
+    arguments (``average``, ``threshold``, ``top_k``, ``num_classes``,
+    ``multiclass``, ``ignore_index``) behave exactly as documented on
+    :func:`~metrics_tpu.functional.precision`; differences specific to
+    accuracy:
+
+    Args:
+        mdmc_average: defaults to ``"global"`` (extra sample dimensions
+            fold into the batch) rather than rejecting multidim input.
+        subset_accuracy: for multilabel/multidim input, a sample scores 1
+            only when EVERY one of its labels is correct.
 
     Example:
         >>> import jax.numpy as jnp
@@ -168,6 +179,9 @@ def accuracy(
         >>> target = jnp.asarray([0, 1, 2, 3])
         >>> preds = jnp.asarray([0, 2, 1, 3])
         >>> print(round(float(accuracy(preds, target)), 4))
+        0.5
+        >>> probs = jnp.asarray([[0.1, 0.9], [0.8, 0.2]])
+        >>> print(round(float(accuracy(probs, jnp.asarray([1, 1]), top_k=1)), 4))
         0.5
     """
     allowed_average = ["micro", "macro", "weighted", "samples", "none", None]

@@ -132,7 +132,26 @@ def auroc(
     max_fpr: Optional[float] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Array:
-    """Area under the ROC curve (binary / multiclass / multilabel).
+    """Area under the ROC curve in one stateless call — the probability a
+    random positive outranks a random negative. Functional twin of
+    :class:`~metrics_tpu.AUROC`; trapezoidal integration over the sorted
+    score curve.
+
+    Args:
+        preds: binary scores ``[N]`` or per-class scores ``[N, C]``.
+        target: labels ``[N]``, or ``[N, C]`` for multilabel.
+        num_classes: class count for multiclass scores.
+        pos_label: the label treated as positive in binary input.
+        average: multiclass/multilabel reduction — ``"macro"`` /
+            ``"weighted"`` / ``"micro"`` (multilabel only) / ``None`` for
+            the per-class vector.
+        max_fpr: integrate only up to this false-positive rate, rescaled
+            by the McClish correction (binary only).
+        sample_weights: optional per-sample weights for the curve counts.
+
+    Raises:
+        ValueError: ``max_fpr`` outside ``(0, 1]``, multiclass scores
+            without ``num_classes``, or targets that are not label-encoded.
 
     Example:
         >>> import jax.numpy as jnp

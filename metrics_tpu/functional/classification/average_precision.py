@@ -93,7 +93,20 @@ def average_precision(
     average: Optional[str] = "macro",
     sample_weights: Optional[Sequence] = None,
 ) -> Union[List[Array], Array]:
-    """Average precision score (area under the PR step curve).
+    """Average precision — area under the precision–recall step curve —
+    in one stateless call. Functional twin of
+    :class:`~metrics_tpu.AveragePrecision`; preferred over
+    :func:`~metrics_tpu.functional.auroc` under heavy class imbalance,
+    since true negatives never enter the curve.
+
+    Args:
+        preds: binary scores ``[N]`` or per-class scores ``[N, C]``.
+        target: labels of the matching shape.
+        num_classes: class count for multiclass scores.
+        pos_label: the label treated as positive in binary input.
+        average: ``"macro"`` / ``"weighted"`` / ``"micro"`` / ``None``
+            (per-class list), as on the class form.
+        sample_weights: optional per-sample weights for the curve counts.
 
     Example:
         >>> import jax.numpy as jnp

@@ -93,8 +93,14 @@ def fbeta(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    r"""F-beta :math:`(1+\beta^2)\frac{P \cdot R}{\beta^2 P + R}`
-    (reference ``f_beta.py:111-215``).
+    r"""F-beta :math:`(1+\beta^2)\frac{P \cdot R}{\beta^2 P + R}` in one
+    stateless call (reference ``f_beta.py:111-215``) — the functional twin
+    of :class:`~metrics_tpu.FBeta`. ``beta`` sets the precision/recall
+    trade-off (``<1`` precision-leaning, ``>1`` recall-leaning); the
+    shared classification arguments (``average``, ``mdmc_average``,
+    ``ignore_index``, ``num_classes``, ``threshold``, ``top_k``,
+    ``multiclass``) behave exactly as documented on
+    :func:`~metrics_tpu.functional.precision`.
 
     Example:
         >>> import jax.numpy as jnp
@@ -127,7 +133,9 @@ def f1(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    """F1 = F-beta with beta=1 (reference ``f_beta.py:218-320``).
+    """F1 — the harmonic mean of precision and recall; :func:`fbeta` with
+    ``beta = 1`` (reference ``f_beta.py:218-320``). Arguments as
+    documented on :func:`~metrics_tpu.functional.precision`.
 
     Example:
         >>> import jax.numpy as jnp

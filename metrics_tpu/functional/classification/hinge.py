@@ -89,7 +89,14 @@ def hinge(
     squared: bool = False,
     multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
 ) -> Array:
-    r"""Mean hinge loss :math:`\max(0, 1 - margin)`, typically for SVMs.
+    r"""Mean hinge loss :math:`\max(0, 1 - \text{margin})` in one
+    stateless call — the functional twin of :class:`~metrics_tpu.Hinge`.
+
+    Binary decision values ``[N]`` score against targets {0, 1} (mapped
+    to ±1). Multiclass scores ``[N, C]`` use ``multiclass_mode``:
+    ``None``/``"crammer-singer"`` takes the true class's margin over the
+    best wrong class; ``"one-vs-all"`` scores one binary hinge per class
+    and returns ``[C]``. ``squared=True`` squares each per-sample loss.
 
     Example:
         >>> import jax.numpy as jnp

@@ -90,7 +90,31 @@ def precision(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    r"""Precision :math:`\frac{TP}{TP + FP}` (reference ``precision_recall.py:76``).
+    r"""Precision :math:`\frac{TP}{TP + FP}` in one stateless call
+    (reference ``precision_recall.py:76``) — the functional twin of
+    :class:`~metrics_tpu.Precision`.
+
+    Args:
+        preds: predictions — labels, probabilities, or logits in any
+            supported classification shape (``[N]``, ``[N, C]``,
+            ``[N, C, X]``).
+        target: ground-truth labels of the matching shape.
+        average: ``"micro"`` pools every decision into one tp/fp count;
+            ``"macro"`` averages per-class scores equally; ``"weighted"``
+            weights them by support; ``"samples"`` scores per sample;
+            ``"none"``/``None`` returns the ``[C]`` vector.
+        mdmc_average: multidim policy — ``"global"`` flattens the extra
+            dimension, ``"samplewise"`` averages per-sample scores,
+            ``None`` rejects multidim input.
+        ignore_index: class label excluded from every counter.
+        num_classes: class count; required for per-class averages.
+        threshold: binarization cut for probabilistic input.
+        top_k: count top-k multiclass hits instead of argmax only.
+        multiclass: force/forbid multiclass interpretation.
+
+    Returns:
+        A scalar, or ``[C]`` / ``[N]`` under per-class / samplewise
+        reduction.
 
     Example:
         >>> import jax.numpy as jnp
@@ -120,7 +144,10 @@ def recall(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    r"""Recall :math:`\frac{TP}{TP + FN}` (reference ``precision_recall.py:214``).
+    r"""Recall :math:`\frac{TP}{TP + FN}` in one stateless call (reference
+    ``precision_recall.py:214``) — the functional twin of
+    :class:`~metrics_tpu.Recall`. All arguments behave exactly as
+    documented on :func:`precision`; only the compute-time ratio differs.
 
     Example:
         >>> import jax.numpy as jnp
@@ -150,7 +177,13 @@ def precision_recall(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Tuple[Array, Array]:
-    """Both precision and recall from one stat-scores pass (reference ``precision_recall.py:352``).
+    """Both precision and recall from a SINGLE stat-scores pass over the
+    inputs (reference ``precision_recall.py:352``) — half the formatting
+    and counting work of calling :func:`precision` and :func:`recall`
+    separately. Arguments as documented on :func:`precision`.
+
+    Returns:
+        ``(precision, recall)`` tuple, each shaped by ``average``.
 
     Example:
         >>> import jax.numpy as jnp

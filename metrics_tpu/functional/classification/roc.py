@@ -77,7 +77,28 @@ def roc(
     pos_label: Optional[int] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-    """(fpr, tpr, thresholds) — per class lists for multiclass/multilabel.
+    """Receiver-operating-characteristic curve in one call (the stateless
+    twin of :class:`~metrics_tpu.ROC`).
+
+    Sorts the scores once, cumulative-sums hits/misses over the sorted
+    order (`_binary_clf_curve`) and prepends the conventional origin
+    point — O(N log N), no python loop, jittable for binary input.
+
+    Args:
+        preds: binary scores ``[N]``, or per-class scores ``[N, C]``.
+        target: labels ``[N]`` (binary/multiclass) or ``[N, C]``
+            (multilabel).
+        num_classes: class count for multiclass scores; inferred from the
+            trailing dimension when possible.
+        pos_label: label counted as positive for binary input.
+        sample_weights: optional per-sample weights folded into the
+            true/false-positive counts.
+
+    Returns:
+        ``(fpr, tpr, thresholds)`` arrays for binary input; for
+        multiclass/multilabel, three lists with one array per class.
+        ``thresholds[0]`` is one above the best score (the "predict
+        nothing" end of the curve).
 
     Example:
         >>> import jax.numpy as jnp
@@ -87,6 +108,8 @@ def roc(
         >>> fpr, tpr, thresholds = roc(pred, target, pos_label=1)
         >>> print(fpr)
         [0. 0. 0. 0. 1.]
+        >>> print(tpr)
+        [0.         0.33333334 0.6666667  1.         1.        ]
     """
     preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
     return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

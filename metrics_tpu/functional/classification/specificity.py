@@ -44,7 +44,34 @@ def specificity(
     top_k: Optional[int] = None,
     multiclass: Optional[bool] = None,
 ) -> Array:
-    r"""Specificity :math:`\frac{TN}{TN + FP}` (reference ``specificity.py:70-215``).
+    r"""Specificity :math:`\frac{TN}{TN + FP}` in one stateless call — the
+    true-negative rate (reference ``specificity.py:70-215``). Functional
+    twin of :class:`~metrics_tpu.Specificity`.
+
+    Args:
+        preds: predictions — labels, probabilities, or logits in any
+            supported classification shape (``[N]``, ``[N, C]``,
+            ``[N, C, X]``).
+        target: ground-truth labels of the matching shape.
+        average: ``"micro"`` pools all decisions; ``"macro"`` /
+            ``"weighted"`` / ``"samples"`` / ``"none"``/``None`` as
+            documented on :class:`~metrics_tpu.Precision`.
+        mdmc_average: multidim policy (``"global"``/``"samplewise"``/
+            ``None``).
+        ignore_index: class label excluded from every counter.
+        num_classes: class count; required for per-class averages.
+        threshold: binarization cut for probabilistic input.
+        top_k: count top-k multiclass hits instead of argmax only.
+        multiclass: force/forbid multiclass interpretation.
+
+    Returns:
+        A scalar, or ``[C]`` for per-class averages / ``[N]`` for
+        samplewise reduction.
+
+    Raises:
+        ValueError: invalid ``average``/``mdmc_average`` combination,
+            per-class average without ``num_classes``, or out-of-range
+            ``ignore_index``.
 
     Example:
         >>> import jax.numpy as jnp

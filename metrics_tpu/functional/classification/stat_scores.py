@@ -158,11 +158,30 @@ def stat_scores(
     multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Count tp/fp/tn/fn/support with flexible reduction.
+    """Count tp/fp/tn/fn/support with flexible reduction — the stateless
+    primitive underneath the whole precision/recall/accuracy family.
 
-    Public functional entry point; contract identical to the reference's
-    ``stat_scores`` (``functional/classification/stat_scores.py:240-397``):
-    returns a ``(..., 5)`` array of ``[tp, fp, tn, fn, support]``.
+    Contract identical to the reference's ``stat_scores``
+    (``functional/classification/stat_scores.py:240-397``).
+
+    Args:
+        preds: predictions — labels, probabilities, or logits in any
+            supported classification shape.
+        target: ground-truth labels of the matching shape.
+        reduce: counter granularity — ``"micro"`` one global quartet,
+            ``"macro"`` a ``[C]`` quartet per class, ``"samples"`` one per
+            sample.
+        mdmc_reduce: multidim policy (``"global"``/``"samplewise"``/
+            ``None``).
+        num_classes: class count; required for ``"macro"``.
+        top_k: one-hot the k best multiclass scores instead of the argmax.
+        threshold: binarization cut for probabilistic input.
+        multiclass: force/forbid multiclass interpretation.
+        ignore_index: class label whose rows/columns drop from every count.
+
+    Returns:
+        ``[..., 5]`` stacked ``[tp, fp, tn, fn, support]`` — ``[5]`` for
+        micro, ``[C, 5]`` macro, ``[N, 5]`` samplewise.
 
     Example:
         >>> import jax.numpy as jnp

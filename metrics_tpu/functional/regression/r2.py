@@ -72,7 +72,27 @@ def r2_score(
     adjusted: int = 0,
     multioutput: str = "uniform_average",
 ) -> Array:
-    """R² (coefficient of determination), optionally adjusted / multioutput.
+    r"""R² :math:`1 - \frac{\sum_i (y_i - \hat{y}_i)^2}{\sum_i (y_i -
+    \bar{y})^2}` — the fraction of target variance the predictions
+    explain. 1 is perfect, 0 is the mean-predictor baseline, negative is
+    worse than predicting the mean.
+
+    Computed from four streaming moments (Σy, Σy², residual sum, count),
+    so the class form accumulates in O(1) memory.
+
+    Args:
+        preds: predictions ``[N]`` or ``[N, D]`` for multioutput.
+        target: ground truth of the same shape.
+        adjusted: when ``> 0``, apply the degrees-of-freedom correction
+            for this many regressors: :math:`1 - (1 - R^2)\frac{n - 1}
+            {n - k - 1}` — penalizes adding uninformative features.
+        multioutput: how the ``[D]`` per-output scores collapse —
+            ``"uniform_average"`` (mean), ``"raw_values"`` (return the
+            vector), ``"variance_weighted"`` (weight by target variance).
+
+    Raises:
+        ValueError: negative/non-int ``adjusted`` or unknown
+            ``multioutput``.
 
     Example:
         >>> import jax.numpy as jnp

@@ -10,7 +10,25 @@ from metrics_tpu.functional.regression.r2 import _r2_score_compute, _r2_score_up
 
 
 class R2Score(Metric):
-    r"""R² with optional adjustment and multioutput aggregation.
+    r"""R² (coefficient of determination) — the fraction of target
+    variance the predictions explain; 1 perfect, 0 the mean-predictor
+    baseline, negative worse than the mean.
+
+    Accumulates four streaming moments per output (Σy, Σy², residual sum,
+    count) as "sum" states — O(1) memory in samples, one ``psum`` set
+    across the mesh, and exact merges for checkpoint resume.
+
+    Args:
+        num_outputs: number of regression outputs ``D`` (default 1).
+        adjusted: degrees-of-freedom correction for this many regressors
+            (see :func:`~metrics_tpu.functional.r2_score`).
+        multioutput: ``"uniform_average"`` / ``"raw_values"`` /
+            ``"variance_weighted"`` collapse of the per-output scores.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    Raises:
+        ValueError: negative ``adjusted`` or unknown ``multioutput``.
 
     Example:
         >>> import jax.numpy as jnp
