@@ -14,7 +14,24 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class CalibrationError(Metric):
-    r"""Top-label calibration error: L1 (ECE), L2 (RMSCE) or max (MCE) norm.
+    r"""Top-label calibration error — how far predicted confidence is from
+    realized accuracy, binned by confidence.
+
+    Each sample's top-class confidence lands in one of ``n_bins`` equal
+    bins; per bin the gap :math:`|\text{acc} - \text{conf}|` is weighted
+    by bin population and reduced by ``norm``: ``"l1"`` the Expected
+    Calibration Error (ECE), ``"l2"`` its root-mean-square variant,
+    ``"max"`` the worst bin (MCE). State is three ``[n_bins]`` sum
+    leaves — constant memory, one ``psum`` set.
+
+    Args:
+        n_bins: number of equal-width confidence bins.
+        norm: ``"l1"`` / ``"l2"`` / ``"max"`` as above.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    Raises:
+        ValueError: unknown ``norm`` or non-positive ``n_bins``.
 
     Example:
         >>> import jax.numpy as jnp

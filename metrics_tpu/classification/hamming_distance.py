@@ -16,7 +16,21 @@ from metrics_tpu.functional.classification.hamming_distance import (
 
 
 class HammingDistance(Metric):
-    r"""Average Hamming loss: fraction of wrongly predicted labels.
+    r"""Hamming loss — the fraction of individual labels predicted wrong,
+    scored independently per label. For multilabel input this is the
+    natural "how many tags did I get wrong" rate (a sample with 9 of 10
+    tags right contributes 0.1, where subset accuracy would score it 0).
+
+    State is a correct/total counter pair ("sum" leaves; one ``psum``
+    pair across the mesh).
+
+    Args:
+        threshold: binarization cut for probabilistic input.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    Raises:
+        ValueError: ``threshold`` outside ``(0, 1)``.
 
     Example:
         >>> import jax.numpy as jnp

@@ -14,8 +14,22 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class KLDivergence(Metric):
-    r"""KL divergence accumulated over batches; sum states for mean/sum
-    reduction, cat-states for per-sample output.
+    r"""KL divergence :math:`D_{KL}(P\|Q) = \sum_x P(x)\log\frac{P(x)}
+    {Q(x)}` between paired distributions ``p`` and ``q``, accumulated
+    over batches. Asymmetric: measures the information lost when ``q``
+    stands in for ``p``.
+
+    Args:
+        log_prob: inputs are already log-probabilities (no normalization
+            or clamping applied).
+        reduction: ``"mean"`` (default) / ``"sum"`` over samples — scalar
+            sum states; ``"none"`` returns per-sample values — "cat"
+            states that grow with the stream.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    Raises:
+        ValueError: mismatched shapes or an unknown ``reduction``.
 
     Example:
         >>> import jax.numpy as jnp

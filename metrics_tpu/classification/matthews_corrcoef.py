@@ -16,7 +16,21 @@ from metrics_tpu.functional.classification.matthews_corrcoef import (
 
 
 class MatthewsCorrcoef(Metric):
-    r"""Matthews correlation coefficient from an accumulated confusion matrix.
+    r"""Matthews correlation coefficient — the correlation between
+    predicted and true labels, computed from a full accumulated confusion
+    matrix. Unlike accuracy or F1 it uses all four counts symmetrically,
+    making it the robust single number under class imbalance: +1 perfect,
+    0 chance, −1 total disagreement.
+
+    State is the ``[C, C]`` confusion-matrix sum leaf (one ``psum``).
+    Degenerate marginals (an all-one-class stream) yield NaN, matching
+    the reference and sklearn (0/0).
+
+    Args:
+        num_classes: number of classes (sets the static state shape).
+        threshold: binarization cut for probabilistic input.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
 
     Example:
         >>> import jax.numpy as jnp

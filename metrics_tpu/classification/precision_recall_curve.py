@@ -17,7 +17,25 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class PrecisionRecallCurve(Metric):
-    """Precision-recall pairs over all distinct thresholds (exact).
+    """Exact precision–recall pairs at every distinct score threshold.
+
+    Scores/targets accumulate as "cat" states; :meth:`compute` sorts once
+    and cumulative-sums. Memory grows with the stream — for large or
+    unbounded streams prefer
+    :class:`~metrics_tpu.BinnedPrecisionRecallCurve`, whose fixed
+    thresholds keep state at ``[C, T]`` sums (and dispatch to the pallas
+    kernel on TPU).
+
+    Args:
+        num_classes: class count for multiclass scores ``[N, C]``;
+            ``None`` for binary ``[N]``.
+        pos_label: the label treated as positive in binary input.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    :meth:`compute` returns ``(precision, recall, thresholds)`` — arrays
+    for binary, per-class lists for multiclass. The final (1, 0) point is
+    appended so the curve always spans recall 1 → 0.
 
     Example:
         >>> import jax.numpy as jnp

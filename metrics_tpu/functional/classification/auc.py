@@ -47,7 +47,21 @@ def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
 
 
 def auc(x: Array, y: Array, reorder: bool = False) -> Array:
-    """Trapezoidal area under the (x, y) curve.
+    """Trapezoidal area under an arbitrary sampled ``(x, y)`` curve — the
+    generic integrator behind AUROC, usable directly on any curve you
+    produced yourself.
+
+    Args:
+        x: x-coordinates ``[N]``; must be monotonic unless ``reorder``.
+        y: y-coordinates ``[N]``.
+        reorder: sort the points by x first (ties keep input order).
+            Leave False for curves that are already monotonic — sorting a
+            non-injective curve (e.g. an ROC with repeated x) can change
+            the area.
+
+    Raises:
+        ValueError: mismatched lengths, or non-monotonic x with
+            ``reorder=False``.
 
     Example:
         >>> import jax.numpy as jnp

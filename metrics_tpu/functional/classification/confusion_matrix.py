@@ -73,7 +73,20 @@ def confusion_matrix(
     threshold: float = 0.5,
     multilabel: bool = False,
 ) -> Array:
-    """[C, C] confusion matrix (or [C, 2, 2] per-label matrices if multilabel).
+    """``[C, C]`` confusion matrix in one stateless call — rows true
+    classes, columns predicted (``[C, 2, 2]`` per-label stacks when
+    ``multilabel=True``). Functional twin of
+    :class:`~metrics_tpu.ConfusionMatrix`; one one-hot scatter-add, no
+    python loop over classes.
+
+    Args:
+        preds: labels or probabilities in any supported shape.
+        target: ground-truth labels.
+        num_classes: number of classes ``C``.
+        normalize: divide at the end — ``"true"`` by row sums, ``"pred"``
+            by column sums, ``"all"`` by the total; ``None`` raw counts.
+        threshold: binarization cut for probabilistic input.
+        multilabel: independent per-label binary decisions.
 
     Example:
         >>> import jax.numpy as jnp

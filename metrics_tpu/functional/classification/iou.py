@@ -44,7 +44,21 @@ def iou(
     num_classes: Optional[int] = None,
     reduction: str = "elementwise_mean",
 ) -> Array:
-    r"""Jaccard index :math:`J(A,B) = \frac{|A\cap B|}{|A\cup B|}`.
+    r"""Jaccard index :math:`J(A,B) = \frac{|A\cap B|}{|A\cup B|}` in one
+    stateless call — per-class intersection-over-union read off a
+    confusion matrix. Functional twin of :class:`~metrics_tpu.IoU`.
+
+    Args:
+        preds: labels or probabilities in any supported shape.
+        target: ground-truth labels.
+        ignore_index: class excluded from the final reduction (still
+            counts toward other classes' unions).
+        absent_score: score assigned to a class absent from both preds
+            and target (0/0 union).
+        threshold: binarization cut for probabilistic input.
+        num_classes: class count; inferred from the data when omitted.
+        reduction: ``"elementwise_mean"`` / ``"sum"`` / ``"none"`` (the
+            per-class vector).
 
     Example:
         >>> import jax.numpy as jnp

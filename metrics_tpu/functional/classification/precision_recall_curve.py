@@ -149,7 +149,22 @@ def precision_recall_curve(
     pos_label: Optional[int] = None,
     sample_weights: Optional[Sequence] = None,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
-    """Precision-recall pairs for all distinct thresholds (eager, exact).
+    """Exact precision–recall pairs at every distinct score, in one
+    stateless call — functional twin of
+    :class:`~metrics_tpu.PrecisionRecallCurve` (argsort + cumulative sums;
+    O(N log N), no threshold loop).
+
+    Args:
+        preds: binary scores ``[N]`` or per-class scores ``[N, C]``.
+        target: labels of the matching shape.
+        num_classes: class count for multiclass scores.
+        pos_label: the label treated as positive in binary input.
+        sample_weights: optional per-sample weights for the counts.
+
+    Returns:
+        ``(precision, recall, thresholds)`` — arrays for binary input,
+        per-class lists for multiclass; precision/recall carry the
+        appended (1, 0) endpoint so they are one longer than thresholds.
 
     Example:
         >>> import jax.numpy as jnp

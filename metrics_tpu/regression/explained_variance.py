@@ -13,7 +13,21 @@ from metrics_tpu.functional.regression.explained_variance import (
 
 
 class ExplainedVariance(Metric):
-    r"""Explained variance via streaming moment states.
+    r"""Explained variance :math:`1 - \frac{\mathrm{Var}(y - \hat{y})}
+    {\mathrm{Var}(y)}` — like R² but insensitive to a constant prediction
+    offset (it compares variances, not raw residuals).
+
+    Accumulates five streaming moments (n, Σy, Σy², Σerr, Σerr²) as "sum"
+    leaves — O(1) memory in samples, exact cross-device merge.
+
+    Args:
+        multioutput: ``"uniform_average"`` / ``"raw_values"`` /
+            ``"variance_weighted"`` collapse of per-output scores.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
+
+    Raises:
+        ValueError: unknown ``multioutput``.
 
     Example:
         >>> import jax.numpy as jnp

@@ -13,7 +13,23 @@ from metrics_tpu.functional.regression.tweedie_deviance import (
 
 
 class TweedieDevianceScore(Metric):
-    r"""Tweedie deviance for a given power, accumulated over batches.
+    r"""Mean Tweedie deviance — the deviance family that interpolates the
+    classic GLM losses through one ``power`` parameter:
+
+    - ``power = 0``: squared error (normal)
+    - ``power = 1``: Poisson deviance (counts)
+    - ``power = 2``: Gamma deviance (strictly positive, multiplicative)
+    - other values: compound Poisson–Gamma / stable families
+
+    Accumulates a deviance-sum and count ("sum" leaves). Input-domain
+    rules follow the power (e.g. ``power=1`` needs strictly positive
+    preds and non-negative targets, ``power=2`` strictly positive both);
+    violations raise eagerly, and ``0 < power < 1`` is undefined.
+
+    Args:
+        power: the family selector above.
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
 
     Example:
         >>> import jax.numpy as jnp

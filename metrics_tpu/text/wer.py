@@ -9,10 +9,21 @@ from metrics_tpu.functional.text.wer import _wer_compute, _wer_update
 
 
 class WER(Metric):
-    r"""Word error rate: ``(S + D + I) / N`` accumulated over batches.
+    r"""Word error rate ``(S + D + I) / N`` — substitutions, deletions and
+    insertions from the minimum word-level edit distance, over the total
+    reference length, accumulated across batches.
 
-    Strings are processed on host; only the two scalar counters are device
-    state (sum-reduced across ranks).
+    The edit-distance DP runs on host over python strings (tokenized by
+    whitespace); only the two scalar counters (errors, total words) are
+    device state, sum-reduced across ranks — so distributed sync costs one
+    tiny ``psum`` regardless of corpus size. 0.0 is perfect; values can
+    exceed 1.0 when hypotheses insert more words than the reference has.
+
+    Args:
+        concatenate_texts: deprecated no-op kept for reference-v0.6 API
+            compatibility (scores are identical either way here).
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            the standard runtime quartet (see :class:`~metrics_tpu.Metric`).
 
     Example:
         >>> predictions = ["this is the prediction", "there is an other sample"]
