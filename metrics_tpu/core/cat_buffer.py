@@ -43,6 +43,17 @@ def _is_traced(x: Any) -> bool:
 class CatBuffer:
     """A bounded, jit-friendly accumulation buffer for "cat" metric states.
 
+    XLA needs static shapes, so the reference's grow-as-you-go list states
+    (preds/targets for AUROC, PR curves, Spearman, ...) become a
+    fixed-capacity ring: ``append`` is a constant-shape
+    ``dynamic_update_slice`` at the current ``count`` — traceable inside a
+    jitted/scanned step with zero retracing — and consumers mask rows
+    ``>= count`` out of the computation instead of slicing them away.
+    Registered as a pytree, so it flows through ``jit``/``scan``/
+    ``shard_map`` carries; the cross-device gather compacts valid rows
+    from every device's buffer. Overflow raises eagerly (or saturates
+    under tracing, where the count check cannot run).
+
     Attributes:
         capacity: max number of rows (static).
         buffer: ``[capacity, *item_shape]`` array, or ``None`` until the first

@@ -13,7 +13,16 @@ from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 
 class MetricCollection(dict):
-    """An ordered dict of metrics sharing a single ``update``/``forward`` call.
+    """An ordered dict of metrics sharing a single ``update``/``forward``
+    call — pass the superset of inputs once and each member picks the
+    keyword arguments its ``update`` signature accepts.
+
+    Beyond convenience, the collection is the performance seam: its
+    ``pure_forward``/``pure_update`` trace every member into ONE XLA
+    program, so a whole collection's update costs one fused kernel launch
+    and its distributed sync batches into one collective round — the
+    design BASELINE's north-star (<1% metric overhead) is built on.
+    ``clone(prefix=...)`` gives cheap train/val/test copies.
 
     Example:
         >>> import jax.numpy as jnp
