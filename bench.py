@@ -477,6 +477,28 @@ def bench_config5() -> None:
     per_call, compile_s, (v1, v2) = _time_repeat_compute(both, (s_map, s_ndcg), perturb)
     assert np.isfinite(float(np.asarray(v1))) and np.isfinite(float(np.asarray(v2)))
 
+    # fused path: one row store, ONE lexsort for both metrics
+    from metrics_tpu import RetrievalCollection
+
+    coll = RetrievalCollection(
+        {"map": RetrievalMAP(), "ndcg": RetrievalNormalizedDCG()}, num_queries=queries
+    )
+    s_coll = coll.pure_update(coll.init_state(), preds, target, idx)
+
+    def fused(state):
+        return coll.pure_compute(state)
+
+    def perturb_coll(state, i):
+        s2 = dict(state)
+        s2["preds"] = [x + i * 1e-12 for x in state["preds"]]
+        return s2
+
+    per_call_fused, compile_fused, vals = _time_repeat_compute(fused, s_coll, perturb_coll)
+    assert np.allclose(float(np.asarray(vals["map"])), float(np.asarray(v1)), atol=1e-6)
+    assert np.allclose(float(np.asarray(vals["ndcg"])), float(np.asarray(v2)), atol=1e-6)
+    _diag(config=5, fused_ms=round(per_call_fused * 1e3, 2), fused_compile_s=round(compile_fused, 1),
+          fused_vs_separate=round(per_call / per_call_fused, 2) if per_call_fused else None)
+
     # reference mechanism: group rows per query id in python, loop groups
     try:
         import torch
@@ -505,6 +527,10 @@ def bench_config5() -> None:
         vs = None
     _diag(config=5, compile_s=round(compile_s, 1))
     _emit("retrieval_map_ndcg_compute", round(per_call * 1e3, 2), "ms/65536-docs", vs)
+    _emit(
+        "retrieval_map_ndcg_fused_compute", round(per_call_fused * 1e3, 2), "ms/65536-docs",
+        round(base_s / per_call_fused, 1) if vs is not None and per_call_fused else None,
+    )
 
 
 def build_config7_loop():

@@ -57,6 +57,7 @@ from metrics_tpu.regression import (
 )
 from metrics_tpu.image import FID, IS, KID, LPIPS, PSNR, SSIM
 from metrics_tpu.retrieval import (
+    RetrievalCollection,
     RetrievalFallOut,
     RetrievalMAP,
     RetrievalMetric,
@@ -103,6 +104,7 @@ __all__ = [
     "MeanSquaredLogError",
     "PearsonCorrcoef",
     "R2Score",
+    "RetrievalCollection",
     "RetrievalFallOut",
     "RetrievalMAP",
     "RetrievalMetric",

@@ -116,8 +116,13 @@ class RetrievalMetric(Metric, ABC):
         target = dim_zero_cat(self.target)
 
         g = group_by_query(indexes, preds, target, num_groups=self.num_queries)
-        scores = self._segment_metric(g)  # [G]
+        return self._reduce_scores(g, self._segment_metric(g))
 
+    def _reduce_scores(self, g: "GroupedByQuery", scores: Array) -> Array:
+        """Fold per-query ``scores`` [G] into the final mean under this
+        metric's empty-query policy. Shared by :meth:`compute` and
+        :class:`~metrics_tpu.RetrievalCollection` (which scores many
+        metrics off one grouping)."""
         if self.empty_on_negatives:
             empty = segment_sum((1 - (g.target > 0)).astype(jnp.int32), g) == 0
         else:

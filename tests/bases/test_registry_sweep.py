@@ -179,6 +179,11 @@ REGISTRY = {
         {"grad": "nonzero", "bf16_atol": 0.5},
     ),
     # retrieval: indexes stay integral under the cast, preds are float
+    "RetrievalCollection": (
+        lambda: M.RetrievalCollection({"map": M.RetrievalMAP(), "mrr": M.RetrievalMRR()}),
+        [((_bin_prob, _bin_tgt), {"indexes": _ret_idx})],
+        {"bf16_atol": 0.1},
+    ),
     "RetrievalMAP": (M.RetrievalMAP, [((_bin_prob, _bin_tgt), {"indexes": _ret_idx})], {"bf16_atol": 0.1}),
     "RetrievalMRR": (M.RetrievalMRR, [((_bin_prob, _bin_tgt), {"indexes": _ret_idx})], {"bf16_atol": 0.1}),
     "RetrievalPrecision": (M.RetrievalPrecision, [((_bin_prob, _bin_tgt), {"indexes": _ret_idx})], {"bf16_atol": 0.1}),

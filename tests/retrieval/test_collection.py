@@ -1,0 +1,145 @@
+"""RetrievalCollection: member-for-member parity with standalone metrics.
+
+The collection shares one row store and one `group_by_query` sort across
+members; every member must produce EXACTLY the value its standalone
+instance computes from the same stream — across empty-target policies,
+k values, FallOut's inverted policy, NDCG's non-binary targets, and the
+jittable static-num_queries mode.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    RetrievalCollection,
+    RetrievalFallOut,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalRecall,
+)
+
+rng = np.random.RandomState(99)
+N, Q, BATCHES = 256, 16, 4
+_preds = [rng.rand(N).astype(np.float32) for _ in range(BATCHES)]
+_target = [rng.randint(0, 2, N) for _ in range(BATCHES)]
+_indexes = [rng.randint(0, Q, N) for _ in range(BATCHES)]
+# force one query with no positives and one with no negatives
+for t, i in zip(_target, _indexes):
+    t[i == 3] = 0
+    t[i == 7] = 1
+
+
+def _members():
+    return {
+        "map": RetrievalMAP(),
+        "mrr": RetrievalMRR(),
+        "p@4": RetrievalPrecision(k=4),
+        "r@4": RetrievalRecall(k=4),
+        "fallout@4": RetrievalFallOut(k=4),
+        "ndcg": RetrievalNormalizedDCG(),
+    }
+
+
+def _feed(metric):
+    for p, t, i in zip(_preds, _target, _indexes):
+        metric.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(i))
+
+
+@pytest.mark.parametrize("empty_action", ["neg", "pos", "skip"])
+def test_collection_matches_standalone(empty_action):
+    solo = {
+        name: type(m)(empty_target_action=empty_action, **({"k": 4} if "@4" in name else {}))
+        for name, m in _members().items()
+    }
+    coll = RetrievalCollection(
+        {name: type(m)(empty_target_action=empty_action, **({"k": 4} if "@4" in name else {}))
+         for name, m in _members().items()}
+    )
+    for m in solo.values():
+        _feed(m)
+    _feed(coll)
+    got = coll.compute()
+    for name, m in solo.items():
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(m.compute()), atol=1e-6, err_msg=name
+        )
+
+
+def test_collection_jittable_with_num_queries():
+    coll = RetrievalCollection(_members(), num_queries=Q)
+    _feed(coll)
+    state = dict(coll._state)
+
+    jitted = jax.jit(coll.pure_compute)
+    got = jitted(state)
+    eager = coll.compute()
+    for name in eager:
+        np.testing.assert_allclose(np.asarray(got[name]), np.asarray(eager[name]), atol=1e-6)
+
+
+def test_collection_forward_and_reset():
+    coll = RetrievalCollection({"map": RetrievalMAP(), "mrr": RetrievalMRR()})
+    out = coll(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]), indexes=jnp.asarray([0, 0]))
+    assert set(out) == {"map", "mrr"}
+    coll.reset()
+    assert coll.compute() == {"map": 0.0, "mrr": 0.0}
+
+
+def test_collection_nonbinary_rejected_when_any_member_binary():
+    coll = RetrievalCollection({"map": RetrievalMAP(), "ndcg": RetrievalNormalizedDCG()})
+    with pytest.raises(ValueError):
+        coll.update(jnp.asarray([0.5, 0.6]), jnp.asarray([2, 3]), indexes=jnp.asarray([0, 0]))
+    # NDCG-only collection accepts graded relevance
+    graded = RetrievalCollection({"ndcg": RetrievalNormalizedDCG()})
+    graded.update(jnp.asarray([0.5, 0.6, 0.1]), jnp.asarray([2, 3, 0]), indexes=jnp.asarray([0, 0, 0]))
+    solo = RetrievalNormalizedDCG()
+    solo.update(jnp.asarray([0.5, 0.6, 0.1]), jnp.asarray([2, 3, 0]), indexes=jnp.asarray([0, 0, 0]))
+    np.testing.assert_allclose(
+        np.asarray(graded.compute()["ndcg"]), np.asarray(solo.compute()), atol=1e-6
+    )
+
+
+def test_collection_does_not_touch_member_state():
+    """Members are config only: their own accumulated rows survive
+    collection update/reset (code-review r3 finding)."""
+    m = RetrievalMAP()
+    m.update(jnp.asarray([0.9, 0.2]), jnp.asarray([1, 0]), indexes=jnp.asarray([0, 0]))
+    before = float(m.compute())
+    coll = RetrievalCollection({"map": m})
+    coll.update(jnp.asarray([0.1, 0.8]), jnp.asarray([0, 1]), indexes=jnp.asarray([1, 1]))
+    coll.reset()
+    assert float(m.compute()) == pytest.approx(before)
+
+
+def test_collection_inherits_member_num_queries():
+    """A member's static bound makes the collection jittable without
+    repeating it (code-review r3 finding)."""
+    coll = RetrievalCollection([RetrievalMAP(num_queries=Q), RetrievalMRR()])
+    assert coll.num_queries == Q
+    _feed(coll)
+    got = jax.jit(coll.pure_compute)(dict(coll._state))
+    eager = coll.compute()
+    for name in eager:
+        np.testing.assert_allclose(np.asarray(got[name]), np.asarray(eager[name]), atol=1e-6)
+    # inherited bound still rejects the 'error' policy combination
+    with pytest.raises(ValueError, match="incompatible"):
+        RetrievalCollection([
+            RetrievalMAP(num_queries=Q),
+            RetrievalMRR(empty_target_action="error"),
+        ])
+
+
+def test_collection_validation_errors():
+    with pytest.raises(ValueError, match="RetrievalMetric instances"):
+        RetrievalCollection({"bad": object()})
+    with pytest.raises(ValueError, match="incompatible"):
+        RetrievalCollection({"map": RetrievalMAP(empty_target_action="error")}, num_queries=4)
+    with pytest.raises(ValueError, match="share a class name"):
+        RetrievalCollection([RetrievalMAP(), RetrievalMAP()])
+    with pytest.raises(ValueError, match="cannot be None"):
+        RetrievalCollection({"map": RetrievalMAP()}).update(
+            jnp.asarray([0.5]), jnp.asarray([1]), indexes=None
+        )
