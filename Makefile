@@ -1,4 +1,4 @@
-.PHONY: test test-fast doctest docs bench perf-smoke clean
+.PHONY: test test-par test-fast doctest docs bench perf-smoke clean
 
 # Dev workflow targets (analogue of the reference's Makefile:1-28, minus the
 # network-dependent env/pip steps — this image is zero-egress).
@@ -10,6 +10,11 @@ clean:
 # full suite on the 8-device virtual CPU mesh (conftest pins the platform)
 test:
 	python -m pytest tests/ -q -rs
+
+# same suite fanned over 4 xdist workers (each worker gets its own 8-device
+# virtual mesh; the persistent compile cache handles concurrent writers)
+test-par:
+	python -m pytest tests/ -q -n 4
 
 # skip the slow marks (BERT jit, subprocess DDP, real-weight parity)
 test-fast:

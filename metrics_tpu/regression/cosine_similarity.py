@@ -13,7 +13,17 @@ from metrics_tpu.utils.data import dim_zero_cat
 
 
 class CosineSimilarity(Metric):
-    r"""Cosine similarity over accumulated rows (cat-states).
+    r"""Cosine similarity between paired vectors — the angle, not the
+    magnitude. 1-D inputs are treated as ONE vector pair (flattened
+    across batches at compute); N-D inputs score one similarity per
+    last-axis row.
+
+    Args:
+        reduction: ``"sum"`` / ``"mean"`` over the per-row similarities,
+            or ``"none"`` for the vector.
+
+    Values accumulate as "cat" states so the flattened-pair semantics
+    stay exact across batches.
 
     Example:
         >>> import jax.numpy as jnp

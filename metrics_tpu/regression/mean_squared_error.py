@@ -13,7 +13,9 @@ from metrics_tpu.functional.regression.mean_squared_error import (
 
 
 class MeanSquaredError(Metric):
-    r"""MSE (or RMSE with ``squared=False``), accumulated over batches.
+    r"""Mean squared error — or RMSE with ``squared=False`` (the sqrt is
+    applied to the GLOBAL mean at compute, not per batch, so streaming
+    accumulation stays exact). State: squared-error sum + count.
 
     Example:
         >>> import jax.numpy as jnp

@@ -50,7 +50,15 @@ def _final_aggregation(
 
 
 class PearsonCorrcoef(Metric):
-    r"""Pearson correlation via mergeable running moments.
+    r"""Pearson correlation coefficient between a prediction and target
+    stream — linear association in [-1, 1].
+
+    State is five running moments (mean, variance, covariance, count per
+    side) with ``dist_reduce_fx=None`` and a pairwise-merge formula
+    (Chan et al.-style) supplied via ``merge_state`` — numerically stable
+    single-pass accumulation that merges exactly across devices, batches,
+    and checkpoint resumes. Expects 1-D inputs; both must be the same
+    shape.
 
     Example:
         >>> import jax.numpy as jnp

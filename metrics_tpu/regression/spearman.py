@@ -14,7 +14,14 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 
 class SpearmanCorrcoef(Metric):
-    r"""Spearman rank correlation over accumulated samples (cat-states).
+    r"""Spearman rank correlation — Pearson correlation of the
+    tie-averaged RANKS, capturing any monotonic (not just linear)
+    association in [-1, 1].
+
+    Ranking needs all samples at once, so values accumulate as "cat"
+    states (``all_gather`` across the mesh) and the rank transform runs
+    at compute; memory grows with the stream. Ranks are piecewise
+    constant in the inputs, so the metric is not differentiable.
 
     Example:
         >>> import jax.numpy as jnp
