@@ -8,7 +8,7 @@ machinery (``metric.py:217-242``). Two paths:
   with ``jax.lax.psum`` / ``pmean`` / ``pmax`` / ``pmin`` over a named mesh axis;
   "cat" states use ``jax.lax.all_gather(..., tiled=True)``. Use inside
   ``shard_map`` / ``pmap`` — collectives ride ICI, one fused XLA program.
-- **Host path** (:func:`host_allgather_pytree`): out-of-jit sync across JAX
+- **Host path** (:func:`host_sync_state`): out-of-jit sync across JAX
   processes via ``multihost_utils.process_allgather``, mirroring the reference's
   eager ``compute()``-time gather. Uneven leading dims are handled with the
   gather-sizes → pad-to-max → gather → trim protocol (reference

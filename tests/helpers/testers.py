@@ -414,15 +414,9 @@ class MetricTester:
         devices = np.array(jax.devices()[:world])
         mesh = Mesh(devices, axis_names=("dp",))
 
-        # rank-strided assignment: rank r gets batches r, r+world, ...
-        def stride(x: np.ndarray) -> jnp.ndarray:
-            return jnp.asarray(np.stack([
-                np.stack([x[i] for i in range(r, NUM_BATCHES, world)]) for r in range(world)
-            ]))  # [world, per_rank, ...]
-
-        p_sh = stride(preds)
-        t_sh = stride(target)
-        kw_sh = {k: stride(np.asarray(v)) for k, v in kwargs_update.items()}
+        p_sh = stride_by_rank(preds, world)
+        t_sh = stride_by_rank(target, world)
+        kw_sh = {k: stride_by_rank(np.asarray(v), world) for k, v in kwargs_update.items()}
 
         # metrics with only fixed-shape states run the FULL fused pipeline
         # (update + collectives + compute) inside the traced program; cat-state
@@ -455,6 +449,15 @@ class MetricTester:
         # metrics used here must be permutation-invariant over samples
         sk_result = sk_metric(total_preds, total_target)
         _assert_allclose(result, sk_result, atol=self.atol)
+
+
+def stride_by_rank(x: np.ndarray, world: int, num_batches: int = NUM_BATCHES) -> jnp.ndarray:
+    """Rank-strided batch assignment ``[world, num_batches // world, ...]``:
+    rank r gets batches r, r+world, ... (shared by `run_sharded_metric_test`
+    and the sharded-collection tests)."""
+    return jnp.asarray(np.stack([
+        np.stack([x[i] for i in range(r, num_batches, world)]) for r in range(world)
+    ]))
 
 
 def accumulate_and_merge(metric_factory, preds, target, world, num_batches=NUM_BATCHES):

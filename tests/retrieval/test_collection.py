@@ -132,6 +132,45 @@ def test_collection_inherits_member_num_queries():
         ])
 
 
+def test_collection_sharded_sync_matches_eager():
+    """Per-device update -> pure_sync('dp') -> compute over a real 2-device
+    shard_map must equal the eager all-data values for every member."""
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from tests.helpers.testers import stride_by_rank
+
+    world = 2
+    coll = RetrievalCollection({"map": RetrievalMAP(), "mrr": RetrievalMRR()})
+
+    devices = np.array(jax.devices()[:world])
+    mesh = Mesh(devices, axis_names=("dp",))
+    per_rank = BATCHES // world
+
+    p_sh = stride_by_rank(np.asarray(_preds), world, num_batches=BATCHES)
+    t_sh = stride_by_rank(np.asarray(_target), world, num_batches=BATCHES)
+    i_sh = stride_by_rank(np.asarray(_indexes), world, num_batches=BATCHES)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(P("dp"),) * 3, out_specs=P(), check_vma=False)
+    def sharded(p, t, i):
+        state = coll.init_state()
+        for b in range(per_rank):
+            state = coll.pure_update(state, p[0, b], t[0, b], indexes=i[0, b])
+        return coll.pure_sync(state, "dp")
+
+    synced = sharded(p_sh, t_sh, i_sh)
+    got = coll.pure_compute(synced)
+
+    eager = RetrievalCollection({"map": RetrievalMAP(), "mrr": RetrievalMRR()})
+    _feed(eager)
+    want = eager.compute()
+    for name in want:
+        np.testing.assert_allclose(
+            np.asarray(got[name]), np.asarray(want[name]), atol=1e-6, err_msg=name
+        )
+
+
 def test_collection_validation_errors():
     with pytest.raises(ValueError, match="RetrievalMetric instances"):
         RetrievalCollection({"bad": object()})
