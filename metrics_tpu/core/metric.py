@@ -982,7 +982,24 @@ def _wrap_compute(compute: Callable) -> Callable:
 
 
 class CompositionalMetric(Metric):
-    """Lazy arithmetic over metrics (reference ``metric.py:722-800``)."""
+    """Lazy arithmetic over metrics (reference ``metric.py:722-800``).
+
+    Built by the 30+ operator overloads on :class:`Metric` — e.g.
+    ``f1 = 2 * (precision * recall) / (precision + recall)`` yields a
+    metric whose ``update`` fans out to both operands (deduplicated when
+    the same instance appears on both sides) and whose ``compute`` applies
+    the operator tree to the operands' computed values. Constants
+    (floats/arrays) embed directly. Picklable; composes recursively.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Precision, Recall
+        >>> p, r = Precision(), Recall()
+        >>> f1 = 2 * (p * r) / (p + r)
+        >>> _ = f1(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 0, 1]))
+        >>> print(round(float(f1.compute()), 4))
+        0.75
+    """
 
     def __init__(
         self,
