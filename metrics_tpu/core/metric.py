@@ -986,10 +986,17 @@ class CompositionalMetric(Metric):
 
     Built by the 30+ operator overloads on :class:`Metric` — e.g.
     ``f1 = 2 * (precision * recall) / (precision + recall)`` yields a
-    metric whose ``update`` fans out to both operands (deduplicated when
-    the same instance appears on both sides) and whose ``compute`` applies
-    the operator tree to the operands' computed values. Constants
-    (floats/arrays) embed directly. Picklable; composes recursively.
+    metric whose ``update`` fans out to both operands and whose
+    ``compute`` applies the operator tree to the operands' computed
+    values. Constants (floats/arrays) embed directly. Picklable; composes
+    recursively.
+
+    Note (matches the reference's semantics): an operand appearing at
+    several places in the tree receives ``update`` once per occurrence —
+    the expression above updates ``precision`` twice per step. Ratio-style
+    metrics are unaffected (uniform scaling of their counters cancels),
+    but scale-sensitive compositions (raw sums/counts) should bind each
+    instance once.
 
     Example:
         >>> import jax.numpy as jnp
@@ -1043,6 +1050,11 @@ class CompositionalMetric(Metric):
             if isinstance(self.metric_b, Metric)
             else self.metric_b
         )
+        # operand forwards accumulated state; mark the composite updated so a
+        # later compute() does not warn spuriously (the reference reaches the
+        # same flag through its base forward -> update path)
+        self._update_called = True
+        self._computed = None
         if val_a is None:
             return None
         if val_b is None:
@@ -1054,6 +1066,10 @@ class CompositionalMetric(Metric):
         return self._forward_cache
 
     def reset(self) -> None:
+        # clear the composite's OWN caches (_computed/_update_called/
+        # _forward_cache) too — resetting only the operands would leave a
+        # stale _computed that a later compute() silently returns
+        super().reset()
         if isinstance(self.metric_a, Metric):
             self.metric_a.reset()
         if isinstance(self.metric_b, Metric):

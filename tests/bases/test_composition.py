@@ -238,3 +238,34 @@ def test_tuple_returning_compute_composition_is_loud():
     combo = TupleMetric() + TupleMetric()
     with pytest.raises(TypeError):
         combo.compute()
+
+
+def test_forward_then_compute_does_not_warn():
+    """Composite forward marks the composite updated: a later compute() must
+    not emit the compute-before-update warning (the reference reaches the
+    flag through its base forward -> update path)."""
+    import warnings
+
+    from metrics_tpu import Precision, Recall
+
+    p, r = Precision(), Recall()
+    f1 = 2 * (p * r) / (p + r)
+    f1(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 0, 1]))
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        val = f1.compute()
+    np.testing.assert_allclose(float(val), 0.75, atol=1e-6)
+
+
+def test_reset_clears_composite_cache():
+    """reset() must clear the composite's own compute cache, not only the
+    operands' states — a stale _computed must not survive (code-review r3)."""
+    from metrics_tpu import Precision, Recall
+
+    p, r = Precision(), Recall()
+    f1 = 2 * (p * r) / (p + r)
+    f1(jnp.asarray([1, 0, 1, 1]), jnp.asarray([1, 0, 0, 1]))
+    np.testing.assert_allclose(float(f1.compute()), 0.75, atol=1e-6)
+    f1.reset()
+    post = float(f1.compute())  # empty stat-scores -> 0/0 -> not the stale 0.75
+    assert not np.isclose(post, 0.75), f"stale cached value survived reset: {post}"
