@@ -45,7 +45,11 @@ def cohen_kappa(
     weights: Optional[str] = None,
     threshold: float = 0.5,
 ) -> Array:
-    r"""Cohen's kappa inter-annotator agreement score.
+    r"""Cohen's kappa in one stateless call — agreement between preds and
+    target discounted by chance agreement (+1 perfect, 0 chance level).
+    Functional twin of :class:`~metrics_tpu.CohenKappa`; ``weights``
+    ∈ {``None``, ``"linear"``, ``"quadratic"``} penalizes disagreements
+    by (squared) label distance for ordinal labels.
 
     Example:
         >>> import jax.numpy as jnp

@@ -27,7 +27,11 @@ def _hamming_distance_compute(correct: Array, total: Union[int, Array]) -> Array
 
 
 def hamming_distance(preds: Array, target: Array, threshold: float = 0.5) -> Array:
-    r"""Average Hamming loss: fraction of labels predicted incorrectly.
+    r"""Hamming loss in one stateless call — the fraction of individual
+    labels predicted wrong, each label scored independently (contrast
+    subset accuracy, which scores all-or-nothing per sample). Functional
+    twin of :class:`~metrics_tpu.HammingDistance`; ``threshold``
+    binarizes probabilistic input.
 
     Example:
         >>> import jax.numpy as jnp

@@ -27,7 +27,11 @@ def matthews_corrcoef(
     num_classes: int,
     threshold: float = 0.5,
 ) -> Array:
-    r"""MCC — general correlation quality of a classification.
+    r"""Matthews correlation coefficient in one stateless call — the
+    correlation between predicted and true labels off a full confusion
+    matrix, robust under class imbalance (+1 perfect, 0 chance, −1 total
+    disagreement; NaN on degenerate single-class marginals, matching
+    sklearn). Functional twin of :class:`~metrics_tpu.MatthewsCorrcoef`.
 
     Example:
         >>> import jax.numpy as jnp
