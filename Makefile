@@ -1,4 +1,4 @@
-.PHONY: test test-par test-fast doctest docs bench perf-smoke clean
+.PHONY: test test-par test-fast doctest docs bench perf-smoke verify-pretrained clean
 
 # Dev workflow targets (analogue of the reference's Makefile:1-28, minus the
 # network-dependent env/pip steps — this image is zero-egress).
@@ -38,3 +38,17 @@ bench:
 
 perf-smoke:
 	python -m pytest -m perf -q
+
+# one-command real-weight acceptance (docs/api.md "Pretrained parity checks"):
+#   make verify-pretrained FIDELITY_CKPT=... INCEPTION_CKPT=... BERT_DIR=...
+# any subset of the three; absent artifacts skip with instructions.
+# make vars default from already-exported METRICS_TPU_* env vars so an
+# operator's `export METRICS_TPU_FIDELITY_CKPT=...` is honored, not clobbered
+FIDELITY_CKPT ?= $(METRICS_TPU_FIDELITY_CKPT)
+INCEPTION_CKPT ?= $(METRICS_TPU_INCEPTION_CKPT)
+BERT_DIR ?= $(METRICS_TPU_BERT_DIR)
+verify-pretrained:
+	METRICS_TPU_FIDELITY_CKPT="$(FIDELITY_CKPT)" \
+	METRICS_TPU_INCEPTION_CKPT="$(INCEPTION_CKPT)" \
+	METRICS_TPU_BERT_DIR="$(BERT_DIR)" \
+	python -m pytest tests/models/test_pretrained_parity.py -v -rs

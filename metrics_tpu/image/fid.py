@@ -74,8 +74,12 @@ class FID(Metric):
     Args:
         feature: Inception tap (64 | 192 | 768 | 2048) for the default
             extractor, or any callable ``imgs -> [N, D] features``.
-        weights: pretrained torchvision inception_v3 state dict / checkpoint
-            path for the default extractor (random init otherwise).
+        weights: pretrained inception state dict / checkpoint path for the
+            default extractor (random init otherwise).
+        variant: backbone forward semantics — 'fidelity' (default) is the
+            ``inception-v3-compat`` graph the reference's scores are defined
+            on (reference ``fid.py:242``; use a torch-fidelity checkpoint);
+            'torchvision' for torchvision ``inception_v3`` checkpoints.
         streaming: accumulate (sum, outer-product sum, count) sufficient
             statistics instead of buffering features — constant memory,
             exactly equivalent mean/cov, recommended on TPU.
@@ -98,6 +102,7 @@ class FID(Metric):
         self,
         feature: Union[int, str, Callable] = 2048,
         weights: Optional[Any] = None,
+        variant: str = "fidelity",
         streaming: bool = False,
         feature_dim: Optional[int] = None,
         sqrtm_method: str = "auto",
@@ -120,7 +125,7 @@ class FID(Metric):
             self.inception = feature
             feat_dim = feature_dim
         elif isinstance(feature, (int, str)) and str(feature) in ("64", "192", "768", "2048"):
-            self.inception = InceptionFeatureExtractor(feature=feature, weights=weights)
+            self.inception = InceptionFeatureExtractor(feature=feature, weights=weights, variant=variant)
             feat_dim = int(feature)
         else:
             raise ValueError(

@@ -21,6 +21,8 @@ class IS(Metric):
             integer tap, or a callable extractor returning logits.
         splits: number of chunks the dataset is split into.
         weights: pretrained inception checkpoint for the default extractor.
+        variant: 'fidelity' (default, the reference's inception-v3-compat
+            graph) or 'torchvision' — see :class:`~metrics_tpu.FID`.
         seed: PRNG seed for the pre-split shuffle (explicit JAX PRNG; the
             reference uses torch's global RNG, ``inception.py:160-162``).
 
@@ -41,6 +43,7 @@ class IS(Metric):
         feature: Union[int, str, Callable] = "logits_unbiased",
         splits: int = 10,
         weights: Optional[Any] = None,
+        variant: str = "fidelity",
         seed: int = 42,
         compute_on_step: bool = False,
         dist_sync_on_step: bool = False,
@@ -58,7 +61,7 @@ class IS(Metric):
         elif isinstance(feature, (int, str)) and str(feature) in (
             "64", "192", "768", "2048", "logits_unbiased",
         ):
-            self.inception = InceptionFeatureExtractor(feature=feature, weights=weights)
+            self.inception = InceptionFeatureExtractor(feature=feature, weights=weights, variant=variant)
         else:
             raise ValueError(f"Got unknown input to argument `feature`: {feature}")
         self.splits = splits

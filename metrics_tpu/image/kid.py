@@ -56,6 +56,8 @@ class KID(Metric):
         subset_size: samples per subset.
         degree / gamma / coef: polynomial kernel parameters.
         weights: pretrained inception checkpoint for the default extractor.
+        variant: 'fidelity' (default, the reference's inception-v3-compat
+            graph) or 'torchvision' — see :class:`~metrics_tpu.FID`.
         seed: PRNG seed for subset sampling (explicit, reproducible — the
             reference relies on torch's global RNG).
 
@@ -81,6 +83,7 @@ class KID(Metric):
         gamma: Optional[float] = None,
         coef: float = 1.0,
         weights: Optional[Any] = None,
+        variant: str = "fidelity",
         seed: int = 42,
         compute_on_step: bool = False,
         dist_sync_on_step: bool = False,
@@ -96,7 +99,7 @@ class KID(Metric):
         if callable(feature):
             self.inception = feature
         elif isinstance(feature, (int, str)) and str(feature) in ("64", "192", "768", "2048"):
-            self.inception = InceptionFeatureExtractor(feature=feature, weights=weights)
+            self.inception = InceptionFeatureExtractor(feature=feature, weights=weights, variant=variant)
         else:
             raise ValueError(
                 f"Integer input to argument `feature` must be one of (64, 192, 768, 2048), got {feature}"

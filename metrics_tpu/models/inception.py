@@ -8,13 +8,31 @@ the graph. Feature taps mirror torch-fidelity's: '64' (first maxpool), '192'
 (second maxpool), '768' (pre-aux), '2048' (final avgpool), 'logits_unbiased'
 and 'logits'.
 
-Weights: the architecture matches torchvision's ``inception_v3`` so
-pretrained weights can be loaded from a torch state dict with
+Two forward variants share ONE params pytree (every architectural difference
+between them lives in parameter-free pooling/preprocessing, so a converted
+checkpoint works with either):
+
+- ``variant="fidelity"`` (default) — torch-fidelity's ``inception-v3-compat``
+  TF-port, the backbone the reference's FID/KID/IS scores are defined on
+  (reference ``image/fid.py:242``: ``NoTrainInceptionV3(name="inception-v3-compat")``).
+  vs torchvision: the ``branch_pool`` average pools in the A blocks
+  (Mixed_5b/5c/5d), C blocks (Mixed_6b–6e) and Mixed_7b exclude the zero
+  padding from the divisor (torch ``count_include_pad=False``); Mixed_7c's
+  pool branch is a 3x3/1 *max* pool; the head has 1008 logits; input is
+  uint8 [0, 255] resized with TensorFlow-1.x-style bilinear interpolation
+  (``src = dst * in/out``, no half-pixel shift) then normalized
+  ``(x - 128) / 128``.
+- ``variant="torchvision"`` — torchvision's ``inception_v3`` eval graph
+  (include-pad average pools everywhere, [0, 1] input, half-pixel bilinear
+  resize, ``x * 2 - 1``), for checkpoints exported from torchvision.
+
+Weights: load either flavour of torch state dict with
 :func:`load_torch_inception_weights` (no network access required — the user
-supplies the checkpoint). Without weights the extractor runs with
-deterministic random init: every FID/KID/IS *mechanism* works (and is
-tested), but scores are not comparable with published pretrained-Inception
-numbers — same caveat the reference prints when torch-fidelity is absent.
+supplies the checkpoint; torchvision and torch-fidelity checkpoints use the
+same module names). Without weights the extractor runs with deterministic
+random init: every FID/KID/IS *mechanism* works (and is tested), but scores
+are not comparable with published pretrained-Inception numbers — same caveat
+the reference prints when torch-fidelity is absent.
 """
 from typing import Any, Dict, Optional, Sequence, Tuple, Union
 
@@ -69,6 +87,52 @@ def _avg_pool_same(x: Array, window: int = 3) -> Array:
     return summed / (window * window)
 
 
+def _avg_pool_same_nopad(x: Array, window: int = 3) -> Array:
+    """3x3 stride-1 SAME average pool dividing by the number of *valid*
+    (unpadded) elements — torch ``avg_pool2d(..., count_include_pad=False)``,
+    the TF-compat semantics torch-fidelity patches into the A/C/E1 blocks.
+    The per-position divisor is a constant XLA folds at compile time."""
+    dims = (1, window, window, 1)
+    strides = (1, 1, 1, 1)
+    summed = lax.reduce_window(x, 0.0, lax.add, dims, strides, "SAME")
+    ones = jnp.ones((1, x.shape[1], x.shape[2], 1), x.dtype)
+    counts = lax.reduce_window(ones, 0.0, lax.add, dims, strides, "SAME")
+    return summed / counts
+
+
+def _max_pool_same(x: Array, window: int = 3) -> Array:
+    """3x3 stride-1 SAME max pool — torch ``max_pool2d(3, 1, padding=1)``,
+    the pool torch-fidelity's Mixed_7c (InceptionE_2) uses in place of the
+    average pool (the TF FID graph's known quirk)."""
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, window, window, 1), (1, 1, 1, 1), "SAME"
+    )
+
+
+def _resize_bilinear_tf1(x: Array, out_h: int, out_w: int) -> Array:
+    """TensorFlow-1.x ``resize_bilinear`` (align_corners=False, no half-pixel
+    centers): source coordinate ``src = dst * (in_size / out_size)`` — NOT the
+    half-pixel convention ``(dst + 0.5) * scale - 0.5`` that
+    ``jax.image.resize``/torch use. torch-fidelity resizes with exactly this
+    kernel (its ``interpolate_bilinear_2d_like_tensorflow1x``) so FID scores
+    match the original TF implementation; reproducing it is required for
+    score parity. Separable gather + lerp over H then W, NHWC."""
+    n, h, w, c = x.shape
+
+    def axis(in_size: int, out_size: int):
+        src = jnp.arange(out_size, dtype=jnp.float32) * (in_size / out_size)
+        lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_size - 1)
+        hi = jnp.minimum(lo + 1, in_size - 1)
+        return lo, hi, src - lo.astype(jnp.float32)
+
+    lo_h, hi_h, fh = axis(h, out_h)
+    lo_w, hi_w, fw = axis(w, out_w)
+    top, bot = x[:, lo_h], x[:, hi_h]
+    x = top + (bot - top) * fh[None, :, None, None]
+    left, right = x[:, :, lo_w], x[:, :, hi_w]
+    return left + (right - left) * fw[None, None, :, None]
+
+
 # ---------------------------------------------------------------------------
 # block initializers — param tree keyed by torchvision module names so the
 # torch state-dict conversion is mechanical
@@ -92,14 +156,14 @@ def _init_inception_a(key: Array, cin: int, pool_features: int) -> Dict[str, Any
     }
 
 
-def _apply_inception_a(p: Dict[str, Any], x: Array) -> Array:
+def _apply_inception_a(p: Dict[str, Any], x: Array, avg_pool=_avg_pool_same) -> Array:
     b1 = _basic_conv(p["branch1x1"], x)
     b5 = _basic_conv(p["branch5x5_1"], x)
     b5 = _basic_conv(p["branch5x5_2"], b5, padding=((2, 2), (2, 2)))
     b3 = _basic_conv(p["branch3x3dbl_1"], x)
     b3 = _basic_conv(p["branch3x3dbl_2"], b3, padding=((1, 1), (1, 1)))
     b3 = _basic_conv(p["branch3x3dbl_3"], b3, padding=((1, 1), (1, 1)))
-    bp = _basic_conv(p["branch_pool"], _avg_pool_same(x))
+    bp = _basic_conv(p["branch_pool"], avg_pool(x))
     return jnp.concatenate([b1, b5, b3, bp], axis=-1)
 
 
@@ -142,7 +206,7 @@ _P17 = ((0, 0), (3, 3))  # pad for 1x7
 _P71 = ((3, 3), (0, 0))  # pad for 7x1
 
 
-def _apply_inception_c(p: Dict[str, Any], x: Array) -> Array:
+def _apply_inception_c(p: Dict[str, Any], x: Array, avg_pool=_avg_pool_same) -> Array:
     b1 = _basic_conv(p["branch1x1"], x)
     b7 = _basic_conv(p["branch7x7_1"], x)
     b7 = _basic_conv(p["branch7x7_2"], b7, padding=_P17)
@@ -152,7 +216,7 @@ def _apply_inception_c(p: Dict[str, Any], x: Array) -> Array:
     bd = _basic_conv(p["branch7x7dbl_3"], bd, padding=_P17)
     bd = _basic_conv(p["branch7x7dbl_4"], bd, padding=_P71)
     bd = _basic_conv(p["branch7x7dbl_5"], bd, padding=_P17)
-    bp = _basic_conv(p["branch_pool"], _avg_pool_same(x))
+    bp = _basic_conv(p["branch_pool"], avg_pool(x))
     return jnp.concatenate([b1, b7, bd, bp], axis=-1)
 
 
@@ -198,7 +262,7 @@ _P13 = ((0, 0), (1, 1))
 _P31 = ((1, 1), (0, 0))
 
 
-def _apply_inception_e(p: Dict[str, Any], x: Array) -> Array:
+def _apply_inception_e(p: Dict[str, Any], x: Array, pool=_avg_pool_same) -> Array:
     b1 = _basic_conv(p["branch1x1"], x)
     b3 = _basic_conv(p["branch3x3_1"], x)
     b3 = jnp.concatenate(
@@ -209,7 +273,7 @@ def _apply_inception_e(p: Dict[str, Any], x: Array) -> Array:
     bd = jnp.concatenate(
         [_basic_conv(p["branch3x3dbl_3a"], bd, padding=_P13),
          _basic_conv(p["branch3x3dbl_3b"], bd, padding=_P31)], axis=-1)
-    bp = _basic_conv(p["branch_pool"], _avg_pool_same(x))
+    bp = _basic_conv(p["branch_pool"], pool(x))
     return jnp.concatenate([b1, b3, bd, bp], axis=-1)
 
 
@@ -250,24 +314,53 @@ def inception_v3_init(key: Optional[Array] = None, num_classes: int = 1008) -> D
 
 
 def inception_v3_apply(
-    params: Dict[str, Any], x: Array, features_list: Sequence[str] = ("2048",)
+    params: Dict[str, Any],
+    x: Array,
+    features_list: Sequence[str] = ("2048",),
+    variant: str = "fidelity",
 ) -> Dict[str, Array]:
     """Forward pass returning the requested feature taps.
 
-    Input ``x``: [N, 3, H, W] (NCHW, like the reference API) float in [0, 1]
-    or uint8 in [0, 255]; resized to 299x299 and normalized to [-1, 1]
-    (torch-fidelity's preprocessing, ``fid.py:38-55`` delegates this to the
-    wrapped model).
+    Input ``x``: [N, 3, H, W] (NCHW, like the reference API) — uint8 in
+    [0, 255] (what the reference's FID ``update`` takes, ``fid.py:252-263``)
+    or float interpreted as [0, 1].
+
+    ``variant="fidelity"`` (default) reproduces torch-fidelity's
+    ``inception-v3-compat`` forward, the graph the reference's scores are
+    defined on (``image/fid.py:242``): TF1-style bilinear resize to 299x299
+    on the [0, 255] scale, ``(x - 128) / 128`` normalization, exclude-pad
+    average pools in A/C/Mixed_7b, max pool in Mixed_7c's pool branch.
+    ``variant="torchvision"`` is torchvision ``inception_v3`` eval semantics.
     """
+    if variant not in ("fidelity", "torchvision"):
+        raise ValueError(f"unknown inception variant {variant!r}; use 'fidelity' or 'torchvision'")
+    fidelity = variant == "fidelity"
     wanted = set(features_list)
     out: Dict[str, Array] = {}
 
-    if x.dtype == jnp.uint8:
-        x = x.astype(jnp.float32) / 255.0
-    x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC (TPU-native layout)
-    if x.shape[1:3] != (299, 299):
-        x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear")
-    x = x * 2.0 - 1.0
+    if fidelity:
+        # torch-fidelity asserts uint8 input and works on the [0, 255] scale;
+        # float [0, 1] input is truncated to the uint8 grid first (the
+        # reference's float path does `(imgs * 255).byte()`) so float and
+        # uint8 presentations of the same image score identically
+        if x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32)
+        else:
+            x = jnp.clip(jnp.floor(x.astype(jnp.float32) * 255.0), 0.0, 255.0)
+        x = jnp.transpose(x, (0, 2, 3, 1))  # NCHW -> NHWC (TPU-native layout)
+        if x.shape[1:3] != (299, 299):
+            x = _resize_bilinear_tf1(x, 299, 299)
+        x = (x - 128.0) / 128.0
+        avg_a = avg_c = pool_e1 = _avg_pool_same_nopad
+        pool_e2 = _max_pool_same
+    else:
+        if x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32) / 255.0
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        if x.shape[1:3] != (299, 299):
+            x = jax.image.resize(x, (x.shape[0], 299, 299, x.shape[3]), method="bilinear")
+        x = x * 2.0 - 1.0
+        avg_a = avg_c = pool_e1 = pool_e2 = _avg_pool_same
 
     x = _basic_conv(params["Conv2d_1a_3x3"], x, stride=(2, 2))
     x = _basic_conv(params["Conv2d_2a_3x3"], x)
@@ -280,19 +373,19 @@ def inception_v3_apply(
     x = _max_pool(x)
     if "192" in wanted:
         out["192"] = jnp.mean(x, axis=(1, 2))
-    x = _apply_inception_a(params["Mixed_5b"], x)
-    x = _apply_inception_a(params["Mixed_5c"], x)
-    x = _apply_inception_a(params["Mixed_5d"], x)
+    x = _apply_inception_a(params["Mixed_5b"], x, avg_a)
+    x = _apply_inception_a(params["Mixed_5c"], x, avg_a)
+    x = _apply_inception_a(params["Mixed_5d"], x, avg_a)
     x = _apply_inception_b(params["Mixed_6a"], x)
-    x = _apply_inception_c(params["Mixed_6b"], x)
-    x = _apply_inception_c(params["Mixed_6c"], x)
-    x = _apply_inception_c(params["Mixed_6d"], x)
-    x = _apply_inception_c(params["Mixed_6e"], x)
+    x = _apply_inception_c(params["Mixed_6b"], x, avg_c)
+    x = _apply_inception_c(params["Mixed_6c"], x, avg_c)
+    x = _apply_inception_c(params["Mixed_6d"], x, avg_c)
+    x = _apply_inception_c(params["Mixed_6e"], x, avg_c)
     if "768" in wanted:
         out["768"] = jnp.mean(x, axis=(1, 2))
     x = _apply_inception_d(params["Mixed_7a"], x)
-    x = _apply_inception_e(params["Mixed_7b"], x)
-    x = _apply_inception_e(params["Mixed_7c"], x)
+    x = _apply_inception_e(params["Mixed_7b"], x, pool_e1)
+    x = _apply_inception_e(params["Mixed_7c"], x, pool_e2)
     pooled = jnp.mean(x, axis=(1, 2))  # adaptive avgpool -> [N, 2048]
     if "2048" in wanted:
         out["2048"] = pooled
@@ -349,7 +442,12 @@ class InceptionFeatureExtractor:
     Args:
         feature: tap to return — 64 | 192 | 768 | 2048 | 'logits_unbiased'.
         weights: optional torch state dict / checkpoint path with pretrained
-            torchvision weights; random (deterministic) init otherwise.
+            weights (torch-fidelity ``pt_inception`` checkpoint for the
+            default variant; torchvision ``inception_v3`` for
+            ``variant="torchvision"``); random (deterministic) init otherwise.
+        variant: 'fidelity' (default — the reference's ``inception-v3-compat``
+            graph, required for score parity with published FID/KID/IS
+            numbers) or 'torchvision'.
         dtype: compute dtype for the CNN (bfloat16 recommended on TPU).
     """
 
@@ -357,17 +455,43 @@ class InceptionFeatureExtractor:
         self,
         feature: Union[int, str] = 2048,
         weights: Optional[Any] = None,
+        variant: str = "fidelity",
         dtype: Any = jnp.float32,
     ) -> None:
         self.feature = str(feature)
+        if variant not in ("fidelity", "torchvision"):
+            # fail at construction, not at the first jitted update mid-epoch
+            raise ValueError(
+                f"unknown inception variant {variant!r}; use 'fidelity' or 'torchvision'"
+            )
+        self.variant = variant
         if weights is not None:
             self.params = load_torch_inception_weights(weights)
+            num_classes = self.params["fc"]["bias"].shape[0]
+            # the two checkpoint families are distinguishable by head width:
+            # torchvision ships 1000 classes, torch-fidelity's compat 1008 —
+            # running one family's weights through the other's graph silently
+            # shifts scores, which is exactly the trap the variant exists to close
+            if variant == "fidelity" and num_classes == 1000:
+                rank_zero_warn(
+                    "variant='fidelity' with a 1000-class (torchvision-style)"
+                    " checkpoint: scores will NOT match torch-fidelity/reference"
+                    " FID. Pass variant='torchvision' for torchvision weights,"
+                    " or load torch-fidelity's pt_inception checkpoint."
+                )
+            elif variant == "torchvision" and num_classes == 1008:
+                rank_zero_warn(
+                    "variant='torchvision' with a 1008-class (torch-fidelity)"
+                    " checkpoint: scores will NOT match either reference graph."
+                    " Drop variant= (default 'fidelity') for torch-fidelity weights."
+                )
         else:
             rank_zero_warn(
                 "InceptionFeatureExtractor initialized with RANDOM weights: metric"
                 " mechanics are exact but scores are not comparable with"
-                " pretrained-Inception numbers. Pass `weights=` a torchvision"
-                " inception_v3 checkpoint for parity."
+                " pretrained-Inception numbers. Pass `weights=` a torch-fidelity"
+                " (or, with variant='torchvision', a torchvision) inception"
+                " checkpoint for parity."
             )
             self.params = inception_v3_init()
         if dtype != jnp.float32:
@@ -378,7 +502,7 @@ class InceptionFeatureExtractor:
         feat = self.feature
 
         def _fwd(params, imgs):
-            return inception_v3_apply(params, imgs, (feat,))[feat].astype(jnp.float32)
+            return inception_v3_apply(params, imgs, (feat,), variant)[feat].astype(jnp.float32)
 
         self._fwd = jax.jit(_fwd)
 

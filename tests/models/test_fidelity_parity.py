@@ -1,0 +1,433 @@
+"""`variant="fidelity"` parity vs a from-scratch torch inception-v3-compat.
+
+The reference's FID/KID/IS are defined on torch-fidelity's TF-ported
+Inception (reference ``image/fid.py:242``:
+``NoTrainInceptionV3(name="inception-v3-compat")``), which differs from
+torchvision's graph in parameter-free ways: exclude-pad average pools in the
+A/C blocks and Mixed_7b, a max pool in Mixed_7c's pool branch, a 1008-logit
+head, TF1-style bilinear input resize and ``(x - 128) / 128`` normalization.
+torch-fidelity is not installed in this image, so the oracle here is a
+compat tower re-built from plain ``torch.nn`` with exactly those semantics
+(the same strategy ``test_weight_parity.py`` uses for torchvision topology):
+random weights → state dict → our converter → assert every tap agrees with
+the live torch forward.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+import torch.nn as nn  # noqa: E402
+import torch.nn.functional as F  # noqa: E402
+
+from metrics_tpu.models.inception import (  # noqa: E402
+    _avg_pool_same_nopad,
+    _max_pool_same,
+    _resize_bilinear_tf1,
+    inception_v3_apply,
+    load_torch_inception_weights,
+)
+
+SEED = 4242
+
+
+def _tf1_resize_torch(x: torch.Tensor, out_h: int, out_w: int) -> torch.Tensor:
+    """Independent TF1 ``resize_bilinear`` oracle (align_corners=False, no
+    half-pixel centers): ``src = dst * in/out``, edge-clamped lerp. NCHW."""
+    _, _, h, w = x.shape
+
+    def axis(in_size, out_size):
+        # float32 grid — the convention torch-fidelity's resize (and our
+        # _resize_bilinear_tf1) computes in
+        src = torch.arange(out_size, dtype=torch.float32) * (in_size / out_size)
+        lo = src.floor().long().clamp(0, in_size - 1)
+        hi = (lo + 1).clamp(max=in_size - 1)
+        return lo, hi, src - lo.float()
+
+    lo_h, hi_h, fh = axis(h, out_h)
+    lo_w, hi_w, fw = axis(w, out_w)
+    top, bot = x[:, :, lo_h], x[:, :, hi_h]
+    x = top + (bot - top) * fh.view(1, 1, -1, 1)
+    left, right = x[:, :, :, lo_w], x[:, :, :, hi_w]
+    return left + (right - left) * fw.view(1, 1, 1, -1)
+
+
+class TestCompatOps:
+    """The three parameter-free ops the fidelity variant changes, each vs its
+    exact torch counterpart."""
+
+    def test_avg_pool_exclude_pad_matches_torch(self):
+        gen = torch.Generator().manual_seed(SEED)
+        x = torch.randn(2, 5, 9, 11, generator=gen)
+        ref = F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+        ours = _avg_pool_same_nopad(jnp.asarray(x.numpy().transpose(0, 2, 3, 1)))
+        np.testing.assert_allclose(
+            np.asarray(ours).transpose(0, 3, 1, 2), ref.numpy(), rtol=1e-6, atol=1e-6
+        )
+
+    def test_max_pool_same_matches_torch(self):
+        gen = torch.Generator().manual_seed(SEED + 1)
+        x = torch.randn(2, 5, 9, 11, generator=gen)
+        ref = F.max_pool2d(x, 3, stride=1, padding=1)
+        ours = _max_pool_same(jnp.asarray(x.numpy().transpose(0, 2, 3, 1)))
+        np.testing.assert_allclose(
+            np.asarray(ours).transpose(0, 3, 1, 2), ref.numpy(), rtol=1e-6, atol=1e-6
+        )
+
+    @pytest.mark.parametrize(
+        "in_hw,out_hw",
+        [
+            ((64, 96), (299, 299)),   # upscale, asymmetric input
+            ((512, 300), (299, 299)),  # downscale
+            ((299, 299), (299, 299)),  # identity sizes
+            ((17, 9), (31, 23)),       # odd sizes both ways
+        ],
+    )
+    def test_tf1_bilinear_resize_matches_oracle(self, in_hw, out_hw):
+        gen = torch.Generator().manual_seed(SEED + 2)
+        x = torch.rand(2, 3, *in_hw, generator=gen) * 255.0
+        ref = _tf1_resize_torch(x, *out_hw)
+        ours = _resize_bilinear_tf1(jnp.asarray(x.numpy().transpose(0, 2, 3, 1)), *out_hw)
+        np.testing.assert_allclose(
+            np.asarray(ours).transpose(0, 3, 1, 2), ref.numpy(), rtol=1e-5, atol=1e-4
+        )
+
+    def test_tf1_resize_golden_values(self):
+        """Golden output of TF1 ``tf.image.resize_bilinear(align_corners=False)``
+        for 2x2 -> 4x4, as documented across the TF issue tracker / resize
+        writeups (the kernel's signature artifact: the last row/column
+        duplicates instead of interpolating, because ``src = dst * in/out``
+        clamps at the edge). Unlike ``_tf1_resize_torch`` (same derivation as
+        the implementation), these constants are EXTERNALLY sourced — they
+        pin the kernel to real TF1 behavior, not to our own formula."""
+        x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])[None, :, :, None]
+        expected = np.array(
+            [
+                [1.0, 1.5, 2.0, 2.0],
+                [2.0, 2.5, 3.0, 3.0],
+                [3.0, 3.5, 4.0, 4.0],
+                [3.0, 3.5, 4.0, 4.0],
+            ]
+        )
+        got = np.asarray(_resize_bilinear_tf1(x, 4, 4))[0, :, :, 0]
+        np.testing.assert_allclose(got, expected, rtol=1e-6, atol=1e-6)
+        # the half-pixel kernel (what torch/jax.image use) interpolates the
+        # edges instead — assert the golden values discriminate between them
+        import jax
+
+        half = np.asarray(jax.image.resize(x, (1, 4, 4, 1), method="bilinear"))[0, :, :, 0]
+        assert np.abs(half - expected).max() > 0.1
+
+    def test_tf1_resize_differs_from_half_pixel(self):
+        """The TF1 kernel is genuinely different from the half-pixel bilinear
+        everyone else uses — guard against silently swapping them."""
+        x = jnp.arange(2 * 3 * 8 * 8, dtype=jnp.float32).reshape(2, 8, 8, 3)
+        import jax
+
+        tf1 = _resize_bilinear_tf1(x, 13, 13)
+        half = jax.image.resize(x, (2, 13, 13, 3), method="bilinear")
+        assert float(jnp.abs(tf1 - half).max()) > 1e-3
+
+
+class _BasicConv2d(nn.Module):
+    def __init__(self, cin, cout, **kw):
+        super().__init__()
+        self.conv = nn.Conv2d(cin, cout, bias=False, **kw)
+        self.bn = nn.BatchNorm2d(cout, eps=1e-3)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Module):
+    """Fidelity InceptionA: exclude-pad average pool in the pool branch."""
+
+    def __init__(self, cin, pool_features):
+        super().__init__()
+        self.branch1x1 = _BasicConv2d(cin, 64, kernel_size=1)
+        self.branch5x5_1 = _BasicConv2d(cin, 48, kernel_size=1)
+        self.branch5x5_2 = _BasicConv2d(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = _BasicConv2d(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = _BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = _BasicConv2d(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = _BasicConv2d(cin, pool_features, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        b3 = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = self.branch_pool(
+            F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+        )
+        return torch.cat([b1, b5, b3, bp], 1)
+
+
+class _InceptionB(nn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3 = _BasicConv2d(cin, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = _BasicConv2d(cin, 64, kernel_size=1)
+        self.branch3x3dbl_2 = _BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = _BasicConv2d(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3(x)
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = F.max_pool2d(x, 3, stride=2)
+        return torch.cat([b3, bd, bp], 1)
+
+
+class _InceptionC(nn.Module):
+    """Fidelity InceptionC: exclude-pad average pool in the pool branch."""
+
+    def __init__(self, cin, c7):
+        super().__init__()
+        self.branch1x1 = _BasicConv2d(cin, 192, kernel_size=1)
+        self.branch7x7_1 = _BasicConv2d(cin, c7, kernel_size=1)
+        self.branch7x7_2 = _BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = _BasicConv2d(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = _BasicConv2d(cin, c7, kernel_size=1)
+        self.branch7x7dbl_2 = _BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = _BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = _BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = _BasicConv2d(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = _BasicConv2d(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_5(
+            self.branch7x7dbl_4(
+                self.branch7x7dbl_3(self.branch7x7dbl_2(self.branch7x7dbl_1(x)))
+            )
+        )
+        bp = self.branch_pool(
+            F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+        )
+        return torch.cat([b1, b7, bd, bp], 1)
+
+
+class _InceptionD(nn.Module):
+    def __init__(self, cin):
+        super().__init__()
+        self.branch3x3_1 = _BasicConv2d(cin, 192, kernel_size=1)
+        self.branch3x3_2 = _BasicConv2d(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = _BasicConv2d(cin, 192, kernel_size=1)
+        self.branch7x7x3_2 = _BasicConv2d(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = _BasicConv2d(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = _BasicConv2d(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3_2(self.branch3x3_1(x))
+        b7 = self.branch7x7x3_4(
+            self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x)))
+        )
+        bp = F.max_pool2d(x, 3, stride=2)
+        return torch.cat([b3, b7, bp], 1)
+
+
+class _InceptionE(nn.Module):
+    """Fidelity InceptionE. ``pool='avg'`` → E_1 (Mixed_7b, exclude-pad avg);
+    ``pool='max'`` → E_2 (Mixed_7c, the TF graph's max-pool quirk)."""
+
+    def __init__(self, cin, pool):
+        super().__init__()
+        assert pool in ("avg", "max")
+        self.pool = pool
+        self.branch1x1 = _BasicConv2d(cin, 320, kernel_size=1)
+        self.branch3x3_1 = _BasicConv2d(cin, 384, kernel_size=1)
+        self.branch3x3_2a = _BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = _BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = _BasicConv2d(cin, 448, kernel_size=1)
+        self.branch3x3dbl_2 = _BasicConv2d(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = _BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = _BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = _BasicConv2d(cin, 192, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        if self.pool == "avg":
+            bp = F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+        else:
+            bp = F.max_pool2d(x, 3, stride=1, padding=1)
+        bp = self.branch_pool(bp)
+        return torch.cat([b1, b3, bd, bp], 1)
+
+
+class _CompatInception(nn.Module):
+    """inception-v3-compat with torchvision state-dict naming and a 1008
+    head — the oracle for `variant="fidelity"`."""
+
+    def __init__(self):
+        super().__init__()
+        self.Conv2d_1a_3x3 = _BasicConv2d(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = _BasicConv2d(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = _BasicConv2d(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = _BasicConv2d(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = _BasicConv2d(80, 192, kernel_size=3)
+        self.Mixed_5b = _InceptionA(192, 32)
+        self.Mixed_5c = _InceptionA(256, 64)
+        self.Mixed_5d = _InceptionA(288, 64)
+        self.Mixed_6a = _InceptionB(288)
+        self.Mixed_6b = _InceptionC(768, 128)
+        self.Mixed_6c = _InceptionC(768, 160)
+        self.Mixed_6d = _InceptionC(768, 160)
+        self.Mixed_6e = _InceptionC(768, 192)
+        self.Mixed_7a = _InceptionD(768)
+        self.Mixed_7b = _InceptionE(1280, pool="avg")
+        self.Mixed_7c = _InceptionE(2048, pool="max")
+        self.fc = nn.Linear(2048, 1008)
+
+    def taps(self, x_uint8):
+        """All six feature taps from a uint8 NCHW batch — torch-fidelity's
+        forward: TF1 resize, (x-128)/128, pooled taps along the trunk."""
+        out = {}
+        x = x_uint8.float()
+        x = _tf1_resize_torch(x, 299, 299)
+        x = (x - 128) / 128
+        x = self.Conv2d_1a_3x3(x)
+        x = self.Conv2d_2a_3x3(x)
+        x = self.Conv2d_2b_3x3(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        out["64"] = F.adaptive_avg_pool2d(x, (1, 1)).flatten(1)
+        x = self.Conv2d_3b_1x1(x)
+        x = self.Conv2d_4a_3x3(x)
+        x = F.max_pool2d(x, 3, stride=2)
+        out["192"] = F.adaptive_avg_pool2d(x, (1, 1)).flatten(1)
+        x = self.Mixed_5b(x)
+        x = self.Mixed_5c(x)
+        x = self.Mixed_5d(x)
+        x = self.Mixed_6a(x)
+        x = self.Mixed_6b(x)
+        x = self.Mixed_6c(x)
+        x = self.Mixed_6d(x)
+        x = self.Mixed_6e(x)
+        out["768"] = F.adaptive_avg_pool2d(x, (1, 1)).flatten(1)
+        x = self.Mixed_7a(x)
+        x = self.Mixed_7b(x)
+        x = self.Mixed_7c(x)
+        pooled = F.adaptive_avg_pool2d(x, (1, 1)).flatten(1)
+        out["2048"] = pooled
+        out["logits_unbiased"] = pooled.mm(self.fc.weight.T)
+        out["logits"] = out["logits_unbiased"] + self.fc.bias
+        return out
+
+
+def _randomize(model: nn.Module, seed: int) -> None:
+    """Non-trivial weights AND bn running stats so a swapped stat or a
+    wrong pool shows up as a tap mismatch."""
+    gen = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, nn.Conv2d):
+                # fan-in (kaiming) scale keeps activations O(1) through ~90
+                # convs — an exploding tower would force sloppy tolerances
+                fan_in = m.weight.shape[1] * m.weight.shape[2] * m.weight.shape[3]
+                m.weight.copy_(
+                    torch.randn(m.weight.shape, generator=gen) * (2.0 / fan_in) ** 0.5
+                )
+            elif isinstance(m, nn.BatchNorm2d):
+                m.weight.copy_(torch.rand(m.weight.shape, generator=gen) + 0.5)
+                m.bias.copy_(torch.randn(m.bias.shape, generator=gen) * 0.2)
+                m.running_mean.copy_(torch.randn(m.running_mean.shape, generator=gen) * 0.3)
+                m.running_var.copy_(torch.rand(m.running_var.shape, generator=gen) + 0.5)
+            elif isinstance(m, nn.Linear):
+                m.weight.copy_(torch.randn(m.weight.shape, generator=gen) * 0.02)
+                m.bias.copy_(torch.randn(m.bias.shape, generator=gen) * 0.1)
+
+
+@pytest.mark.slow
+class TestFidelityTowerParity:
+    def test_all_taps_match_torch_compat_tower(self):
+        tower = _CompatInception().eval()
+        _randomize(tower, SEED)
+        params = load_torch_inception_weights(
+            {k: v for k, v in tower.state_dict().items()}
+        )
+
+        rng = np.random.RandomState(SEED)
+        imgs = rng.randint(0, 256, (2, 3, 96, 128), dtype=np.uint8)
+        with torch.no_grad():
+            ref = {k: v.numpy() for k, v in tower.taps(torch.from_numpy(imgs)).items()}
+
+        ours = inception_v3_apply(
+            params,
+            jnp.asarray(imgs),
+            ("64", "192", "768", "2048", "logits_unbiased", "logits"),
+            variant="fidelity",
+        )
+        for tap in ("64", "192", "768", "2048", "logits_unbiased", "logits"):
+            np.testing.assert_allclose(
+                np.asarray(ours[tap]), ref[tap], rtol=1e-4, atol=1e-4,
+                err_msg=f"tap {tap} diverged (fidelity variant)",
+            )
+
+    def test_float_input_matches_uint8_on_fidelity_path(self):
+        """Float [0,1] input is truncated onto the uint8 grid (the reference's
+        ``(imgs * 255).byte()``), so both presentations of one image must
+        produce identical features."""
+        tower = _CompatInception().eval()
+        _randomize(tower, SEED + 3)
+        params = load_torch_inception_weights(tower.state_dict())
+        rng = np.random.RandomState(SEED)
+        u8 = rng.randint(0, 256, (2, 3, 64, 64), dtype=np.uint8)
+        as_float = (u8.astype(np.float32) + 0.4) / 255.0  # off-grid floats
+        a = inception_v3_apply(params, jnp.asarray(u8), ("64",), variant="fidelity")["64"]
+        b = inception_v3_apply(params, jnp.asarray(as_float), ("64",), variant="fidelity")["64"]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6)
+
+    def test_variants_differ_on_same_params(self):
+        """The two variants must NOT agree — same params, different graphs.
+        If they ever agree, the fidelity switch silently stopped switching."""
+        tower = _CompatInception().eval()
+        _randomize(tower, SEED + 9)
+        params = load_torch_inception_weights(tower.state_dict())
+        rng = np.random.RandomState(SEED)
+        imgs = jnp.asarray(rng.randint(0, 256, (2, 3, 64, 64), dtype=np.uint8))
+        fid = inception_v3_apply(params, imgs, ("2048",), variant="fidelity")["2048"]
+        tv = inception_v3_apply(params, imgs, ("2048",), variant="torchvision")["2048"]
+        assert float(jnp.abs(fid - tv).max()) > 1e-3
+
+
+class TestVariantGuards:
+    def test_unknown_variant_raises_at_construction(self):
+        from metrics_tpu.models.inception import InceptionFeatureExtractor
+
+        with pytest.raises(ValueError, match="unknown inception variant"):
+            InceptionFeatureExtractor(feature=64, variant="fidelty")
+
+    @pytest.mark.parametrize(
+        "num_classes,variant,should_warn",
+        [(1000, "fidelity", True), (1008, "torchvision", True),
+         (1008, "fidelity", False), (1000, "torchvision", False)],
+    )
+    def test_checkpoint_variant_mismatch_warns(self, num_classes, variant, should_warn):
+        """1000-class head = torchvision family, 1008 = torch-fidelity; a
+        family/variant mismatch silently shifts scores, so it must warn."""
+        import warnings
+
+        from metrics_tpu.models.inception import InceptionFeatureExtractor, inception_v3_init
+
+        tree = inception_v3_init(num_classes=num_classes)
+        sd = {}
+        for name, sub in tree.items():
+            if name == "fc":
+                sd["fc.weight"] = np.zeros((num_classes, 2048), np.float32)
+                sd["fc.bias"] = np.zeros((num_classes,), np.float32)
+                continue
+            branches = {"": sub} if "kernel" in sub else {f".{b}": sub[b] for b in sub}
+            for suffix, conv in branches.items():
+                kh, kw, cin, cout = conv["kernel"].shape
+                sd[f"{name}{suffix}.conv.weight"] = np.zeros((cout, cin, kh, kw), np.float32)
+                for leaf in ("weight", "bias", "running_mean", "running_var"):
+                    sd[f"{name}{suffix}.bn.{leaf}"] = np.ones((cout,), np.float32)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            InceptionFeatureExtractor(feature=64, weights=sd, variant=variant)
+        mismatch = [w for w in caught if "will NOT match" in str(w.message)]
+        assert bool(mismatch) == should_warn

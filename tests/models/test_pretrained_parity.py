@@ -7,18 +7,24 @@ run against published-comparable scores. This image has zero egress, so these
 tests activate only when the operator drops real checkpoints and points env
 vars at them:
 
+- ``METRICS_TPU_FIDELITY_CKPT`` — torch-fidelity's ``inception-v3-compat``
+  checkpoint (``pt_inception-2015-12-05-6726825d.pth``), the backbone the
+  reference's FID/KID/IS numbers are defined on (reference
+  ``image/fid.py:242``). Runs the DEFAULT ``variant="fidelity"`` path on real
+  weights; asserted against a scipy-sqrtm numpy FID over the same features
+  (always) and against torch-fidelity's own forward when importable
+  (reference tolerance atol 1e-3, ``/root/reference/tests/image/test_fid.py:40``).
 - ``METRICS_TPU_INCEPTION_CKPT`` — torchvision ``inception_v3`` ``.pth``
-  (e.g. ``inception_v3_google-0cc3c7bd.pth``). Runs a real-weight FID on a
-  fixed synthetic image set; asserted against (a) a scipy-sqrtm numpy FID on
-  the same features (always), and (b) torch-fidelity's NoTrainInceptionV3
-  features when torchvision is importable (reference tolerance atol 1e-3,
-  ``/root/reference/tests/image/test_fid.py:40``).
+  (e.g. ``inception_v3_google-0cc3c7bd.pth``). Same checks through
+  ``variant="torchvision"``, cross-checked vs the torchvision forward when
+  torchvision is importable.
 - ``METRICS_TPU_BERT_DIR`` — a local HuggingFace BERT directory
   (``config.json`` + torch weights + tokenizer). Runs BERTScore with the
   converted in-repo encoder vs the same scores computed from the
   transformers torch forward.
 
-Recipe: docs/api.md ("Pretrained parity checks").
+One-command entry point: ``make verify-pretrained`` (see docs/api.md,
+"Pretrained parity checks", for the expected-numbers table).
 """
 import os
 
@@ -27,6 +33,7 @@ import numpy as np
 import pytest
 
 _INCEPTION = os.environ.get("METRICS_TPU_INCEPTION_CKPT")
+_FIDELITY = os.environ.get("METRICS_TPU_FIDELITY_CKPT")
 _BERT_DIR = os.environ.get("METRICS_TPU_BERT_DIR")
 
 
@@ -36,34 +43,94 @@ def _fixed_images(n, seed):
     return (rng.randint(0, 256, (n, 3, 299, 299)) / 255.0).astype(np.float32)
 
 
+def _fixed_uint8(n, seed):
+    """uint8 [N,3,299,299] — the input dtype the fidelity variant is defined
+    on (torch-fidelity asserts uint8)."""
+    return np.random.RandomState(seed).randint(0, 256, (n, 3, 299, 299), dtype=np.uint8)
+
+
+def _numpy_scipy_fid(feats_r, feats_f):
+    import scipy.linalg
+
+    feats_r = np.asarray(feats_r, dtype=np.float64)
+    feats_f = np.asarray(feats_f, dtype=np.float64)
+    mu1, mu2 = feats_r.mean(0), feats_f.mean(0)
+    s1 = np.cov(feats_r, rowvar=False)
+    s2 = np.cov(feats_f, rowvar=False)
+    covmean = scipy.linalg.sqrtm(s1 @ s2).real
+    return float(((mu1 - mu2) ** 2).sum() + np.trace(s1 + s2 - 2 * covmean))
+
+
+@pytest.mark.skipif(
+    not (_FIDELITY and os.path.exists(_FIDELITY or "")),
+    reason="set METRICS_TPU_FIDELITY_CKPT to torch-fidelity's pt_inception .pth for inception-v3-compat real-weight parity",
+)
+@pytest.mark.slow
+def test_fid_real_weights_fidelity_variant_against_scipy():
+    """The parity-default path end to end on real compat weights: uint8 in,
+    TF1 resize, compat graph, moments, on-device sqrtm — vs numpy/scipy FID
+    over the same features."""
+    from metrics_tpu import FID
+
+    real = _fixed_uint8(32, 1)
+    fake = _fixed_uint8(32, 2)
+
+    fid = FID(feature=2048, weights=_FIDELITY)  # variant defaults to 'fidelity'
+    fid.update(jnp.asarray(real), real=True)
+    fid.update(jnp.asarray(fake), real=False)
+    ours = float(fid.compute())
+
+    expected = _numpy_scipy_fid(fid.inception(jnp.asarray(real)), fid.inception(jnp.asarray(fake)))
+    np.testing.assert_allclose(ours, expected, atol=1e-3, rtol=1e-3)
+
+
+@pytest.mark.skipif(
+    not (_FIDELITY and os.path.exists(_FIDELITY or "")),
+    reason="set METRICS_TPU_FIDELITY_CKPT to torch-fidelity's pt_inception .pth for inception-v3-compat real-weight parity",
+)
+@pytest.mark.slow
+def test_inception_features_match_torch_fidelity():
+    """Converted compat backbone vs torch-fidelity's own NoTrainInceptionV3
+    forward at real-weight scale — the reference's exact feature source
+    (``image/fid.py:242``). Runs only where torch_fidelity is installed
+    alongside the checkpoint."""
+    torch_fidelity = pytest.importorskip("torch_fidelity")
+    import torch
+
+    from metrics_tpu.models.inception import InceptionFeatureExtractor
+
+    imgs = _fixed_uint8(8, 3)
+
+    ref_model = torch_fidelity.feature_extractor_inceptionv3.FeatureExtractorInceptionV3(
+        "inception-v3-compat", ["2048"], feature_extractor_weights_path=_FIDELITY
+    ).eval()
+    with torch.no_grad():
+        (ref,) = ref_model(torch.from_numpy(imgs))
+    ours = np.asarray(
+        InceptionFeatureExtractor(feature=2048, weights=_FIDELITY)(jnp.asarray(imgs))
+    )
+    np.testing.assert_allclose(ours, ref.numpy(), atol=1e-3, rtol=1e-3)
+
+
 @pytest.mark.skipif(
     not (_INCEPTION and os.path.exists(_INCEPTION or "")),
     reason="set METRICS_TPU_INCEPTION_CKPT to a torchvision inception_v3 .pth for real-weight FID parity",
 )
 @pytest.mark.slow
 def test_fid_real_weights_against_scipy():
-    """Full path (preprocess → pretrained backbone → moments → sqrtm) vs a
-    numpy/scipy FID over the same real-weight features."""
-    import scipy.linalg
-
+    """Full torchvision-variant path (preprocess → pretrained backbone →
+    moments → sqrtm) vs a numpy/scipy FID over the same real-weight features."""
     from metrics_tpu import FID
 
     real = _fixed_images(32, 1)
     fake = _fixed_images(32, 2)
 
-    fid = FID(feature=2048, weights=_INCEPTION)
+    fid = FID(feature=2048, weights=_INCEPTION, variant="torchvision")
     fid.update(jnp.asarray(real), real=True)
     fid.update(jnp.asarray(fake), real=False)
     ours = float(fid.compute())
 
-    feats_r = np.asarray(fid.inception(jnp.asarray(real)), dtype=np.float64)
-    feats_f = np.asarray(fid.inception(jnp.asarray(fake)), dtype=np.float64)
-    mu1, mu2 = feats_r.mean(0), feats_f.mean(0)
-    s1 = np.cov(feats_r, rowvar=False)
-    s2 = np.cov(feats_f, rowvar=False)
-    covmean = scipy.linalg.sqrtm(s1 @ s2).real
-    expected = float(((mu1 - mu2) ** 2).sum() + np.trace(s1 + s2 - 2 * covmean))
-
+    expected = _numpy_scipy_fid(fid.inception(jnp.asarray(real)), fid.inception(jnp.asarray(fake)))
     np.testing.assert_allclose(ours, expected, atol=1e-3, rtol=1e-3)
 
 
@@ -92,7 +159,9 @@ def test_inception_features_match_torchvision():
         x = torch.from_numpy(imgs) * 2 - 1  # torchvision inception expects [-1,1]
         ref = tv(x).numpy()
 
-    ours = np.asarray(InceptionFeatureExtractor(feature=2048, weights=_INCEPTION)(jnp.asarray(imgs)))
+    ours = np.asarray(
+        InceptionFeatureExtractor(feature=2048, weights=_INCEPTION, variant="torchvision")(jnp.asarray(imgs))
+    )
     np.testing.assert_allclose(ours, ref, atol=1e-3, rtol=1e-3)
 
 
