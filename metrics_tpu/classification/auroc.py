@@ -129,7 +129,11 @@ class AUROC(Metric):
             if self.mode == DataType.BINARY and self.pos_label in (None, 1):
                 if preds_cb.buffer is None:
                     raise ValueError("No samples to concatenate")
-                return masked_binary_auroc(preds_cb.buffer, target_cb.buffer, preds_cb.mask())
+                # poison: an in-jit overflow overwrote rows -> NaN, not a
+                # plausible wrong AUROC (cat_buffer.py `poison` contract)
+                return preds_cb.poison(
+                    masked_binary_auroc(preds_cb.buffer, target_cb.buffer, preds_cb.mask())
+                )
             # one-vs-rest vectorized masked path: multiclass [N, C] scores vs
             # int targets, multilabel [N, C] vs [N, C] — one vmapped XLA
             # program (mdmc rows were already flattened to [N*X, C] by
@@ -141,8 +145,10 @@ class AUROC(Metric):
                 and self.mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
                 and target_cb.buffer.ndim == 1
             ):
-                return masked_multiclass_auroc(
-                    preds_cb.buffer, target_cb.buffer, preds_cb.mask(), self.average
+                return preds_cb.poison(
+                    masked_multiclass_auroc(
+                        preds_cb.buffer, target_cb.buffer, preds_cb.mask(), self.average
+                    )
                 )
             if (
                 preds_cb.buffer is not None
@@ -150,8 +156,10 @@ class AUROC(Metric):
                 and self.mode == DataType.MULTILABEL
                 and target_cb.buffer.ndim == 2
             ):
-                return masked_multilabel_auroc(
-                    preds_cb.buffer, target_cb.buffer, preds_cb.mask(), self.average
+                return preds_cb.poison(
+                    masked_multilabel_auroc(
+                        preds_cb.buffer, target_cb.buffer, preds_cb.mask(), self.average
+                    )
                 )
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)

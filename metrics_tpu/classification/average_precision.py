@@ -102,8 +102,12 @@ class AveragePrecision(Metric):
                 # binarize exactly like the curve path (`target == pos_label` in
                 # `_binary_clf_curve`) — raw targets may hold values outside {0,1}
                 binary_target = (target_cb.buffer == self.pos_label).astype(jnp.float32)
-                return masked_binary_average_precision(
-                    preds_cb.buffer, binary_target, preds_cb.mask()
+                # poison: an in-jit overflow overwrote rows -> NaN, not a
+                # plausible wrong AP (cat_buffer.py `poison` contract)
+                return preds_cb.poison(
+                    masked_binary_average_precision(
+                        preds_cb.buffer, binary_target, preds_cb.mask()
+                    )
                 )
             # one-vs-rest vectorized masked path for multiclass [N, C] scores:
             # per-class AP under one vmap, NaN classes excluded from the
@@ -114,8 +118,10 @@ class AveragePrecision(Metric):
                 and target_cb.buffer.ndim == 1
                 and self.average != "micro"
             ):
-                res = masked_multiclass_average_precision(
-                    preds_cb.buffer, target_cb.buffer, preds_cb.mask(), self.average
+                res = preds_cb.poison(
+                    masked_multiclass_average_precision(
+                        preds_cb.buffer, target_cb.buffer, preds_cb.mask(), self.average
+                    )
                 )
                 if self.average is None:
                     # list-of-scalars like the eager path, so the return type

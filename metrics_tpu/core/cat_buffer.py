@@ -22,8 +22,14 @@ Opt in per metric via ``metric.with_capacity(n)``: every declared list state
 becomes a ``CatBuffer``; the metric's ``update``/``compute`` code is unchanged
 (``.append`` and ``dim_zero_cat`` dispatch on the type).
 
-Eager appends past capacity raise; inside jit (no exceptions possible) writes
-clamp at the end of the buffer — size ``capacity`` to your eval set.
+Eager appends past capacity raise. Inside jit (no exceptions possible) an
+overflowing write clamps at the end of the buffer, the fill count saturates
+at ``capacity``, and a persistent ``overflowed`` flag is raised; the flag is
+a pytree leaf, so it survives ``scan`` carries, sync (OR across devices) and
+``merge``, eager reads (``values()``) raise on it, and consumers NaN-poison
+their compute result through :meth:`CatBuffer.poison` — overflow is loud
+everywhere instead of silently overwriting rows. Size ``capacity`` to your
+eval set.
 """
 from typing import Any, Optional, Sequence, Tuple
 
@@ -32,6 +38,7 @@ import jax.numpy as jnp
 from jax import Array, lax
 
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.utils.prints import rank_zero_warn
 
 __all__ = ["CatBuffer", "sync_cat_buffer_in_jit"]
 
@@ -51,24 +58,35 @@ class CatBuffer:
     ``>= count`` out of the computation instead of slicing them away.
     Registered as a pytree, so it flows through ``jit``/``scan``/
     ``shard_map`` carries; the cross-device gather compacts valid rows
-    from every device's buffer. Overflow raises eagerly (or saturates
-    under tracing, where the count check cannot run).
+    from every device's buffer. Overflow raises eagerly; under tracing
+    (where the count check cannot run) the write clamps, ``count``
+    saturates at ``capacity`` and ``overflowed`` latches True — surfaced
+    at compute via :meth:`poison` / eager ``values()``.
 
     Attributes:
         capacity: max number of rows (static).
         buffer: ``[capacity, *item_shape]`` array, or ``None`` until the first
             ``append`` fixes the item shape/dtype.
-        count: scalar int32 — number of valid rows.
+        count: scalar int32 — number of valid rows (saturates at capacity).
+        overflowed: scalar bool — True once any append/merge tried to write
+            past capacity; sticky through copy/merge/sync/checkpoint.
     """
 
-    __slots__ = ("capacity", "buffer", "count")
+    __slots__ = ("capacity", "buffer", "count", "overflowed")
 
-    def __init__(self, capacity: int, buffer: Optional[Array] = None, count: Optional[Array] = None) -> None:
+    def __init__(
+        self,
+        capacity: int,
+        buffer: Optional[Array] = None,
+        count: Optional[Array] = None,
+        overflowed: Optional[Array] = None,
+    ) -> None:
         if capacity <= 0:
             raise ValueError(f"CatBuffer capacity must be positive, got {capacity}")
         self.capacity = int(capacity)
         self.buffer = buffer
         self.count = jnp.zeros((), jnp.int32) if count is None else count
+        self.overflowed = jnp.zeros((), jnp.bool_) if overflowed is None else overflowed
 
     # -- accumulation ---------------------------------------------------
     def append(self, batch: Array) -> "CatBuffer":
@@ -100,7 +118,12 @@ class CatBuffer:
                 )
         start = (self.count,) + (jnp.zeros((), jnp.int32),) * (batch.ndim - 1)
         self.buffer = lax.dynamic_update_slice(self.buffer, batch.astype(self.buffer.dtype), start)
-        self.count = self.count + jnp.asarray(n, jnp.int32)
+        new_total = self.count + jnp.asarray(n, jnp.int32)
+        # under tracing the eager check above cannot run: saturate the count
+        # (dynamic_update_slice already clamped the write) and latch the flag
+        # so the corruption is detectable at compute instead of silent
+        self.overflowed = jnp.logical_or(self.overflowed, new_total > self.capacity)
+        self.count = jnp.minimum(new_total, self.capacity)
         return self
 
     # -- reads ----------------------------------------------------------
@@ -114,18 +137,44 @@ class CatBuffer:
                 "inside jit use `.buffer` with `.mask()` (padding-aware compute), "
                 "or a Binned* metric for a fully-fused constant-shape pipeline."
             )
+        if not _is_traced(self.overflowed) and bool(self.overflowed):
+            raise MetricsTPUUserError(
+                f"CatBuffer overflowed inside jit: more than capacity={self.capacity} "
+                "rows were appended, and late rows overwrote earlier ones. The data "
+                "is corrupt — construct the metric with a larger `with_capacity(...)` "
+                "and re-run."
+            )
         return self.buffer[: int(self.count)]
 
     def mask(self) -> Array:
         """``[capacity]`` bool validity mask — jit-safe padding awareness."""
         return jnp.arange(self.capacity) < self.count
 
+    def poison(self, value: Array) -> Array:
+        """NaN-poison ``value`` if this buffer has overflowed — jit-safe.
+
+        Compute paths that consume ``.buffer``/``.mask()`` inside jit cannot
+        raise; routing their result through ``poison`` turns a corrupted
+        accumulation into NaN (loud) instead of a plausible wrong number
+        (silent). Eagerly, a concrete overflow also emits a rank-zero
+        warning pointing at ``with_capacity``. Reference list states never
+        drop data (``metric.py:112-176``) — this is the TPU-native contract:
+        bounded memory, but corruption is always detectable."""
+        value = jnp.asarray(value)
+        if not _is_traced(self.overflowed) and bool(self.overflowed):
+            rank_zero_warn(
+                f"CatBuffer overflowed (capacity {self.capacity}): compute returns "
+                "NaN. Construct the metric with a larger `with_capacity(...)`."
+            )
+        out_dtype = value.dtype if jnp.issubdtype(value.dtype, jnp.floating) else jnp.float32
+        return jnp.where(self.overflowed, jnp.asarray(jnp.nan, out_dtype), value.astype(out_dtype))
+
     def __len__(self) -> int:
         return int(self.count)
 
     # -- functional structure -------------------------------------------
     def copy(self) -> "CatBuffer":
-        return CatBuffer(self.capacity, self.buffer, self.count)
+        return CatBuffer(self.capacity, self.buffer, self.count, self.overflowed)
 
     def reset(self) -> "CatBuffer":
         return CatBuffer(self.capacity)
@@ -134,12 +183,15 @@ class CatBuffer:
         """New CatBuffer = self's rows then other's rows (capacity = self's).
 
         Static-shape: other's rows scatter at offset ``self.count`` with
-        out-of-bounds rows dropped (eager overflow raises).
+        out-of-bounds rows dropped (eager overflow raises; traced overflow
+        saturates the count and latches ``overflowed``, like ``append``).
         """
         if other.buffer is None:
-            return self.copy()
+            out = self.copy()
+            out.overflowed = jnp.logical_or(self.overflowed, other.overflowed)
+            return out
         if self.buffer is None:
-            base = CatBuffer(self.capacity)
+            base = CatBuffer(self.capacity, overflowed=self.overflowed)
             base.buffer = jnp.zeros((self.capacity,) + other.buffer.shape[1:], other.buffer.dtype)
             base.count = jnp.zeros((), jnp.int32)
             return base.merge(other)
@@ -152,7 +204,11 @@ class CatBuffer:
         rows = jnp.arange(other.capacity)
         idx = jnp.where(rows < other.count, self.count + rows, self.capacity)
         buffer = self.buffer.at[idx].set(other.buffer.astype(self.buffer.dtype), mode="drop")
-        return CatBuffer(self.capacity, buffer, self.count + other.count)
+        new_total = self.count + other.count
+        overflowed = jnp.logical_or(
+            jnp.logical_or(self.overflowed, other.overflowed), new_total > self.capacity
+        )
+        return CatBuffer(self.capacity, buffer, jnp.minimum(new_total, self.capacity), overflowed)
 
     def __repr__(self) -> str:
         item = None if self.buffer is None else self.buffer.shape[1:]
@@ -160,12 +216,12 @@ class CatBuffer:
 
 
 def _catbuffer_flatten(cb: CatBuffer) -> Tuple[Sequence[Any], int]:
-    return (cb.buffer, cb.count), cb.capacity
+    return (cb.buffer, cb.count, cb.overflowed), cb.capacity
 
 
 def _catbuffer_unflatten(capacity: int, children: Sequence[Any]) -> CatBuffer:
-    buffer, count = children
-    return CatBuffer(capacity, buffer, count)
+    buffer, count, overflowed = children
+    return CatBuffer(capacity, buffer, count, overflowed)
 
 
 jax.tree_util.register_pytree_node(CatBuffer, _catbuffer_flatten, _catbuffer_unflatten)
@@ -176,14 +232,23 @@ def sync_cat_buffer_in_jit(cb: CatBuffer, axis_name: str) -> CatBuffer:
 
     Static-shape replacement for the reference's uneven-shape gather protocol
     (``utilities/distributed.py:122-145``): gather ``[W, capacity, ...]``
-    buffers + ``[W]`` counts, then scatter each rank's valid rows at its
-    exclusive-cumsum offset into a ``[W*capacity, ...]`` result. One
-    ``all_gather`` collective per state, rides ICI inside the jitted program.
+    buffers plus one packed ``[W, 2]`` (count, overflow-flag) vector, then
+    scatter each rank's valid rows at its exclusive-cumsum offset into a
+    ``[W*capacity, ...]`` result. Two ``all_gather`` collectives per state,
+    riding ICI inside the jitted program.
     """
     if cb.buffer is None:
         raise MetricsTPUUserError("Cannot sync an empty CatBuffer (no item shape yet).")
     bufs = lax.all_gather(cb.buffer, axis_name)  # [W, cap, ...]
-    counts = lax.all_gather(cb.count, axis_name)  # [W]
+    # the scalar overflow flag rides the counts gather (one packed int32
+    # vector) instead of costing a third collective launch
+    meta = lax.all_gather(
+        jnp.stack([cb.count, cb.overflowed.astype(jnp.int32)]), axis_name
+    )  # [W, 2]
+    counts = meta[:, 0]
+    # per-rank counts saturate at capacity, so sum(counts) <= W*cap = new_cap:
+    # the gather itself cannot overflow — only carry the ranks' OR'd flags
+    overflowed = jnp.any(meta[:, 1] > 0)
     world = bufs.shape[0]
     new_cap = world * cb.capacity
     offsets = jnp.cumsum(counts) - counts
@@ -193,4 +258,4 @@ def sync_cat_buffer_in_jit(cb: CatBuffer, axis_name: str) -> CatBuffer:
     idx = jnp.where(rows[None, :] < counts[:, None], offsets[:, None] + rows[None, :], new_cap)
     out = jnp.zeros((new_cap,) + bufs.shape[2:], cb.buffer.dtype)
     out = out.at[idx.reshape(-1)].set(bufs.reshape((new_cap,) + bufs.shape[2:]), mode="drop")
-    return CatBuffer(new_cap, out, jnp.sum(counts).astype(jnp.int32))
+    return CatBuffer(new_cap, out, jnp.sum(counts).astype(jnp.int32), overflowed)

@@ -106,6 +106,7 @@ def _fresh_state_value(v: Any) -> Any:
             v.capacity,
             None if v.buffer is None else jnp.array(v.buffer, copy=True),
             jnp.array(v.count, copy=True),
+            jnp.array(v.overflowed, copy=True),
         )
     return jnp.array(v, copy=True)
 
@@ -539,6 +540,15 @@ class Metric:
             elif isinstance(a, CatBuffer):
                 out[name] = a.merge(b)
             elif isinstance(b, CatBuffer):
+                # merging INTO a list state loses the overflow flag, so a
+                # corrupt buffer must fail here, loudly and with advice that
+                # fits a capacity-less metric (same policy as load_state_dict)
+                if not isinstance(b.overflowed, jax.core.Tracer) and bool(b.overflowed):
+                    raise MetricsTPUUserError(
+                        f"State {name!r} holds a CatBuffer that overflowed inside "
+                        "jit: its rows are corrupt and cannot be merged into a "
+                        "list-state metric. Re-run with a larger capacity."
+                    )
                 out[name] = list(a) + ([b.values()] if len(b) else [])
             elif isinstance(self._defaults[name], list):
                 out[name] = list(a) + list(b)
@@ -625,6 +635,7 @@ class Metric:
                     "__catbuffer__": v.capacity,
                     "buffer": None if v.buffer is None else np.asarray(v.buffer),
                     "count": np.asarray(v.count),
+                    "overflowed": np.asarray(v.overflowed),
                 }
             elif isinstance(v, list):
                 out[prefix + name] = [np.asarray(x) for x in v]
@@ -643,6 +654,8 @@ class Metric:
                         v["__catbuffer__"],
                         None if v["buffer"] is None else jnp.asarray(v["buffer"]),
                         jnp.asarray(v["count"]),
+                        # absent in pre-overflow-flag checkpoints -> clean
+                        jnp.asarray(v.get("overflowed", False)),
                     )
                 elif isinstance(v, list):
                     loaded = [jnp.asarray(x) for x in v]
@@ -657,12 +670,27 @@ class Metric:
                         cb.append(x)
                     loaded = cb
                 elif isinstance(declared, CatBuffer) and isinstance(loaded, CatBuffer):
-                    # keep this metric's declared capacity, not the checkpoint's
+                    # keep this metric's declared capacity, not the checkpoint's;
+                    # read the raw rows (not values(), which raises on an
+                    # overflowed checkpoint) and carry the flag so the corrupt
+                    # state stays loud at compute rather than failing the load
                     cb = CatBuffer(declared.capacity)
-                    if len(loaded):
-                        cb.append(loaded.values())
+                    if int(loaded.count):
+                        cb.append(loaded.buffer[: int(loaded.count)])
+                    cb.overflowed = jnp.asarray(loaded.overflowed)
                     loaded = cb
                 elif isinstance(declared, list) and isinstance(loaded, CatBuffer):
+                    # a list state has no overflow flag to carry, so a corrupt
+                    # (overflowed) CatBuffer checkpoint cannot stay detectable
+                    # past this point — failing the load IS the loud option,
+                    # with advice that fits a capacity-less metric
+                    if bool(loaded.overflowed):
+                        raise MetricsTPUUserError(
+                            f"Checkpoint state '{key}' holds a CatBuffer that "
+                            "overflowed inside jit: its rows are corrupt and "
+                            "cannot be resumed into a list-state metric. "
+                            "Re-run the accumulation with a larger capacity."
+                        )
                     loaded = [loaded.values()] if len(loaded) else []
                 self._state[name] = loaded
                 self._update_called = True
@@ -758,7 +786,11 @@ class Metric:
         for name in self._defaults:
             v = self._state[name]
             if isinstance(v, CatBuffer):
-                hash_vals.append(np.asarray(v.values()).tobytes())
+                # raw leaves, not values(): hashing must never raise, even on
+                # an overflowed buffer (the flag itself is part of identity)
+                if v.buffer is not None:
+                    hash_vals.append(np.asarray(v.buffer[: int(v.count)]).tobytes())
+                hash_vals.append(np.asarray(v.overflowed).tobytes())
             elif isinstance(v, list):
                 hash_vals.extend(np.asarray(x).tobytes() for x in v)
             else:
@@ -928,6 +960,7 @@ def _wrap_update(update: Callable) -> Callable:
                         d.capacity,
                         buffer=np.zeros(live.buffer.shape, live.buffer.dtype),
                         count=np.zeros((), np.int32),
+                        overflowed=np.zeros((), np.bool_),
                     )
         return out
 

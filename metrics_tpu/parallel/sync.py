@@ -169,6 +169,15 @@ def host_sync_leaf(value: Any, fx: ReduceFx) -> Any:
                 "Cannot sync a CatBuffer state across processes: at least one process "
                 "has an empty state (no update() before sync()). All processes raised."
             )
+        # overflow flags travel the same symmetric protocol: values() below
+        # would raise only on the corrupted rank and hang the rest mid-gather
+        flags = np.asarray(_process_allgather(jnp.asarray(value.overflowed, dtype=jnp.int32)))
+        if (flags != 0).any():
+            raise RuntimeError(
+                "Cannot sync a CatBuffer state across processes: at least one process "
+                "overflowed its capacity (rows were overwritten inside jit). "
+                "All processes raised. Use a larger `with_capacity(...)`."
+            )
         pieces = gather_all_arrays(value.values())  # uneven rows handled
         merged = CatBuffer(world * value.capacity)
         for p in pieces:

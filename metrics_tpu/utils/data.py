@@ -149,6 +149,7 @@ def apply_to_collection(
             data.capacity,
             None if data.buffer is None else apply_to_collection(data.buffer, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs),
             apply_to_collection(data.count, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs),
+            apply_to_collection(data.overflowed, dtype, function, *args, wrong_dtype=wrong_dtype, **kwargs),
         )
     if isinstance(data, Mapping):
         return type(data)(
