@@ -25,19 +25,45 @@ class GroupedByQuery(NamedTuple):
     group_start: Array  # [G] position of each group's first row
 
 
-def group_by_query(indexes: Array, preds: Array, target: Array, num_groups: Optional[int] = None) -> GroupedByQuery:
+def group_by_query(
+    indexes: Array,
+    preds: Array,
+    target: Array,
+    num_groups: Optional[int] = None,
+    valid: Optional[Array] = None,
+) -> GroupedByQuery:
     """Sort rows by (query id asc, score desc) and build segment metadata.
 
     ``num_groups`` may be passed for a jit-static group count; otherwise it is
     read from the data (eager only).
+
+    ``valid`` (with a static ``num_groups``) enables the fully-jittable
+    padded mode for fixed-capacity CatBuffer states: invalid rows are given
+    a sentinel query id so they sort to the very end, then their gid is set
+    to ``num_groups`` — out of range for every ``segment_*`` op, which
+    silently drops them. Group sizes, starts, ranks and reductions therefore
+    count valid rows only, with zero dynamic shapes anywhere.
     """
-    order = jnp.lexsort((-preds, indexes))
+    if valid is not None:
+        if num_groups is None:
+            raise ValueError("`valid` masking needs a static `num_groups` bound")
+        sentinel = jnp.iinfo(jnp.asarray(indexes).dtype).max
+        indexes = jnp.where(valid, indexes, sentinel)
+        preds_key = jnp.where(valid, preds, -jnp.inf)
+    else:
+        preds_key = preds
+    order = jnp.lexsort((-preds_key, indexes))
     idx_s = indexes[order]
     preds_s = preds[order]
     target_s = target[order]
+    valid_s = valid[order] if valid is not None else None
 
     new_group = jnp.concatenate([jnp.asarray([True]), idx_s[1:] != idx_s[:-1]])
     gid = jnp.cumsum(new_group) - 1
+    if valid_s is not None:
+        # padding rows all share the sentinel id = one trailing pseudo-group;
+        # route them out of range so every segment op drops them
+        gid = jnp.where(valid_s, gid, num_groups)
     if num_groups is None:
         num_groups = int(gid[-1]) + 1 if idx_s.size else 0
     elif idx_s.size and not isinstance(gid, jax.core.Tracer):
@@ -45,7 +71,8 @@ def group_by_query(indexes: Array, preds: Array, target: Array, num_groups: Opti
         # (cumsum of boundaries), so the bound constrains the number of
         # DISTINCT query ids, not their magnitude. Out-of-range groups would
         # be silently dropped by the segment ops — be loud while we can.
-        actual = int(gid[-1]) + 1
+        in_range = gid if valid_s is None else jnp.where(valid_s, gid, -1)
+        actual = int(in_range.max()) + 1
         if actual > num_groups:
             raise ValueError(
                 f"`num_queries={num_groups}` is a static upper bound on DISTINCT "
@@ -54,8 +81,11 @@ def group_by_query(indexes: Array, preds: Array, target: Array, num_groups: Opti
 
     positions = jnp.arange(idx_s.shape[0])
     group_start = jax.ops.segment_min(positions, gid, num_segments=num_groups)
-    rank = positions - group_start[gid] + 1
-    group_sizes = jax.ops.segment_sum(jnp.ones_like(gid), gid, num_segments=num_groups)
+    # gather-clamp on out-of-range padding gids yields garbage ranks for
+    # padding rows only; they never reach a reduction (dropped by gid)
+    rank = positions - group_start[jnp.minimum(gid, num_groups - 1)] + 1
+    ones = jnp.ones_like(gid)
+    group_sizes = jax.ops.segment_sum(ones, gid, num_segments=num_groups)
     return GroupedByQuery(preds_s, target_s, gid, rank, num_groups, group_sizes, group_start)
 
 

@@ -118,6 +118,26 @@ class RetrievalCollection(Metric):
         self.target.append(target)
 
     def compute(self) -> Dict[str, Array]:
+        from metrics_tpu.core.cat_buffer import CatBuffer
+
+        state_preds = self._state["preds"]
+        if isinstance(state_preds, CatBuffer) and self.num_queries is not None:
+            # jittable CatBuffer path: one padded grouping (static shapes,
+            # padding dropped by the segment ops), N metrics off it — see
+            # RetrievalMetric.compute
+            if state_preds.buffer is None:
+                return {name: jnp.asarray(0.0) for name in self.metrics}
+            g = group_by_query(
+                self._state["indexes"].buffer,
+                state_preds.buffer,
+                self._state["target"].buffer,
+                num_groups=self.num_queries,
+                valid=state_preds.mask(),
+            )
+            return {
+                name: state_preds.poison(m._reduce_scores(g, m._segment_metric(g)))
+                for name, m in self.metrics.items()
+            }
         if not self.preds:
             return {name: jnp.asarray(0.0) for name in self.metrics}
         indexes = dim_zero_cat(self.indexes)

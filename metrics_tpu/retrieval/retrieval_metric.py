@@ -109,6 +109,26 @@ class RetrievalMetric(Metric, ABC):
         self.target.append(target)
 
     def compute(self) -> Array:
+        from metrics_tpu.core.cat_buffer import CatBuffer
+
+        state_preds = self._state["preds"]
+        if isinstance(state_preds, CatBuffer) and self.num_queries is not None:
+            # fully-jittable CatBuffer path: padded grouping keeps every shape
+            # static, so fixed-capacity update + all_gather sync + THIS compute
+            # fuse into one XLA program (padding rows are routed out of range
+            # by group_by_query's `valid` mode and dropped by the segment ops)
+            if state_preds.buffer is None:
+                return jnp.asarray(0.0)
+            idx_cb: CatBuffer = self._state["indexes"]
+            tgt_cb: CatBuffer = self._state["target"]
+            g = group_by_query(
+                idx_cb.buffer,
+                state_preds.buffer,
+                tgt_cb.buffer,
+                num_groups=self.num_queries,
+                valid=state_preds.mask(),
+            )
+            return state_preds.poison(self._reduce_scores(g, self._segment_metric(g)))
         if not self.preds:
             return jnp.asarray(0.0)
         indexes = dim_zero_cat(self.indexes)

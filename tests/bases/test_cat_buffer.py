@@ -431,3 +431,267 @@ def test_set_dtype_survives_reset():
     assert np.dtype(m.init_state()["preds"].buffer.dtype) == np.float16
     m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
     assert m.preds.buffer.dtype == jnp.float16
+
+
+# ---------------------------------------------------------------------------
+# in-jit overflow detection (eager overflow raises; traced overflow cannot —
+# it must saturate the count, latch the `overflowed` flag, and poison compute)
+# ---------------------------------------------------------------------------
+
+def test_jit_overflow_latches_flag_and_saturates():
+    """A jitted scan that appends past capacity: count saturates at capacity
+    (never inflates the mask), the flag latches, and eager reads raise."""
+    def run(xs):
+        def body(cb, x):
+            return cb.append(x[None]), None
+        cb0 = CatBuffer(4, buffer=jnp.zeros((4,)), count=jnp.asarray(0, jnp.int32))
+        cb, _ = jax.lax.scan(body, cb0, xs)
+        return cb
+
+    cb = jax.jit(run)(jnp.arange(7.0))
+    assert bool(cb.overflowed)
+    assert int(cb.count) == 4  # saturated, not 7
+    assert np.asarray(cb.mask()).sum() == 4
+    with pytest.raises(MetricsTPUUserError, match="overflowed inside jit"):
+        cb.values()
+    # non-overflowing run through the same program stays clean
+    cb_ok = jax.jit(run)(jnp.arange(3.0))
+    assert not bool(cb_ok.overflowed)
+    np.testing.assert_array_equal(np.asarray(cb_ok.values()), [0.0, 1.0, 2.0])
+
+
+def test_jit_overflow_poisons_auroc_compute():
+    """End to end through a metric: overflowing the buffer inside a jitted
+    scan must surface as NaN at compute, not a plausible wrong AUROC."""
+    cap = 2 * BATCH_SIZE
+    m = AUROC().with_capacity(cap)
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m.reset()
+    state = jax.jit(m.pure_update)(m.init_state(), jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    state = jax.tree.map(lambda x: jnp.zeros_like(x), state)
+
+    def body(carry, batch):
+        p, t = batch
+        return m.pure_update(carry, p, t), None
+
+    # 3 batches > 2-batch capacity
+    state, _ = jax.lax.scan(body, state, (jnp.asarray(_preds[:3]), jnp.asarray(_target[:3])))
+    assert bool(state["preds"].overflowed)
+    with pytest.warns(UserWarning, match="CatBuffer overflowed"):
+        out = m.pure_compute(state)
+    assert np.isnan(float(out))
+    # the fused jitted compute path poisons too (no eager warning possible)
+    assert np.isnan(float(jax.jit(m.pure_compute)(state)))
+
+
+def test_sharded_sync_carries_overflow_flag():
+    """One rank overflowing poisons the post-sync state on EVERY rank: the
+    flag rides the all_gather (OR across the mesh axis)."""
+    world = 2
+    mesh = Mesh(np.array(jax.devices()[:world]), ("dp",))
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P(), check_vma=False)
+    def f(base):
+        cb = CatBuffer(4, buffer=jnp.zeros((4,)), count=jnp.asarray(0, jnp.int32))
+        # rank 0 appends 6 rows (overflow), rank 1 appends 2 (clean):
+        # SPMD can't branch per rank, so append 6 then shrink rank 1's
+        # count/flag back to a clean 2-row state
+        for i in range(6):
+            cb = cb.append(base[0, :1] + i)
+        rank = jax.lax.axis_index("dp")
+        cb.count = jnp.where(rank == 1, jnp.asarray(2, jnp.int32), cb.count)
+        cb.overflowed = jnp.where(rank == 1, jnp.asarray(False), cb.overflowed)
+        return sync_cat_buffer_in_jit(cb, "dp")
+
+    out = f(jnp.asarray([[10.0], [20.0]]))
+    assert bool(out.overflowed)
+    assert np.isnan(float(out.poison(jnp.asarray(0.5))))
+    with pytest.raises(MetricsTPUUserError, match="overflowed inside jit"):
+        out.values()
+
+
+def test_merge_carries_overflow_flag():
+    """merge() of clean buffers that jointly exceed capacity latches the flag
+    under tracing (eagerly it raises, covered above)."""
+    def run():
+        a = CatBuffer(4, buffer=jnp.zeros((4,)), count=jnp.asarray(0, jnp.int32))
+        b = CatBuffer(4, buffer=jnp.zeros((4,)), count=jnp.asarray(0, jnp.int32))
+        a = a.append(jnp.arange(3.0))
+        b = b.append(jnp.arange(3.0))
+        return a.merge(b)
+
+    merged = jax.jit(run)()
+    assert bool(merged.overflowed)
+    assert int(merged.count) == 4
+    # and the flag is sticky through a further merge with a clean buffer
+    clean = CatBuffer(4, buffer=jnp.zeros((4,)), count=jnp.asarray(1, jnp.int32))
+    assert bool(jax.jit(lambda m, c: c.merge(m))(merged, clean).overflowed)
+
+
+def test_overflow_flag_roundtrips_state_dict():
+    cap = BATCH_SIZE
+    m = AUROC().with_capacity(cap)
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m.reset()
+    state = jax.jit(m.pure_update)(m.init_state(), jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+
+    def body(carry, batch):
+        p, t = batch
+        return m.pure_update(carry, p, t), None
+
+    state, _ = jax.lax.scan(body, state, (jnp.asarray(_preds[:2]), jnp.asarray(_target[:2])))
+    m._restore(state)
+    m.persistent(True)  # cat states default non-persistent, like the reference
+    sd = m.state_dict()
+    assert bool(sd["preds"]["overflowed"])
+
+    m2 = AUROC().with_capacity(cap)
+    m2.load_state_dict(sd)
+    assert bool(m2.preds.overflowed)
+    # a list-state metric has no flag to carry: loading corrupt rows must
+    # fail the load, loudly and with capacity-less advice
+    m_list = AUROC()
+    with pytest.raises(MetricsTPUUserError, match="cannot be resumed into a list-state"):
+        m_list.load_state_dict(sd)
+    # legacy checkpoints without the flag load clean
+    del sd["preds"]["overflowed"], sd["target"]["overflowed"]
+    m3 = AUROC().with_capacity(cap)
+    m3.load_state_dict(sd)
+    assert not bool(m3.preds.overflowed)
+
+
+def test_reset_clears_overflow_flag():
+    cb = CatBuffer(3, buffer=jnp.zeros((3,)), count=jnp.asarray(0, jnp.int32))
+    # two 2-row appends overflow via the count path (a single batch larger
+    # than capacity is a static-shape error and raises even under jit)
+    cb = jax.jit(lambda c: c.append(jnp.arange(2.0)).append(jnp.arange(2.0)))(cb)
+    assert bool(cb.overflowed)
+    assert not bool(cb.reset().overflowed)
+
+
+# ---------------------------------------------------------------------------
+# jittable ragged retrieval compute (padded segment grouping, segment.py
+# `valid` mode): CatBuffer + static num_queries == fully fused program
+# ---------------------------------------------------------------------------
+
+def _retrieval_data(n=200, n_queries=23, seed=11):
+    r = np.random.RandomState(seed)
+    return (
+        r.rand(n).astype(np.float32),
+        r.randint(0, 2, n),
+        r.randint(0, n_queries, n),
+    )
+
+
+def test_retrieval_catbuffer_jit_compute_matches_eager():
+    """Padded-grouping compute inside jit == the eager list-state value,
+    including a partially-filled buffer (padding rows must not leak into
+    any query's ranking or the query mean)."""
+    preds, target, idx = _retrieval_data()
+    m_list = RetrievalMAP()
+    m_list.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(idx))
+    expected = float(m_list.compute())
+
+    m = RetrievalMAP(num_queries=32).with_capacity(512)  # 200 of 512 filled
+    m.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(idx))
+    state = {k: v for k, v in m._state.items()}
+    np.testing.assert_allclose(float(m.pure_compute(state)), expected, atol=1e-6)
+    np.testing.assert_allclose(
+        float(jax.jit(m.pure_compute)(state)), expected, atol=1e-6
+    )
+
+
+def test_retrieval_catbuffer_sharded_sync_straddling_queries():
+    """Query groups straddling device boundaries: only the post-gather global
+    grouping merges them; value must equal the single-process oracle."""
+    world = 4
+    mesh = Mesh(np.array(jax.devices()[:world]), ("dp",))
+    per_rank = 16
+    preds, _, _ = _retrieval_data(world * per_rank)
+    m = RetrievalMAP(num_queries=world * per_rank // 5 + 1).with_capacity(per_rank)
+    # warm item spec
+    m.update(jnp.zeros((2,)), jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32))
+    m.reset()
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P(), check_vma=False)
+    def run(p):
+        rank = jax.lax.axis_index("dp")
+        gpos = rank * per_rank + jnp.arange(per_rank)
+        st = m.pure_update(
+            m.init_state(), p[0], (gpos % 3 == 0).astype(jnp.int32), (gpos // 5).astype(jnp.int32)
+        )
+        return m.pure_compute(m.pure_sync(st, "dp"))
+
+    got = float(run(jnp.asarray(preds.reshape(world, per_rank))))
+
+    oracle = RetrievalMAP()
+    gpos = np.arange(world * per_rank)
+    oracle.update(
+        jnp.asarray(preds), jnp.asarray((gpos % 3 == 0).astype(np.int32)), jnp.asarray((gpos // 5).astype(np.int32))
+    )
+    np.testing.assert_allclose(got, float(oracle.compute()), atol=1e-6)
+
+
+def test_retrieval_catbuffer_overflow_poisons_map():
+    m = RetrievalMAP(num_queries=8).with_capacity(8)
+    m.update(jnp.zeros((2,)), jnp.zeros((2,), jnp.int32), jnp.zeros((2,), jnp.int32))
+    m.reset()
+    state = m.init_state()
+
+    def body(carry, batch):
+        p, t, i = batch
+        return m.pure_update(carry, p, t, i), None
+
+    r = np.random.RandomState(3)
+    batches = (
+        jnp.asarray(r.rand(3, 4).astype(np.float32)),
+        jnp.asarray(r.randint(0, 2, (3, 4))),
+        jnp.asarray(r.randint(0, 8, (3, 4))),
+    )
+    state, _ = jax.lax.scan(body, state, batches)  # 12 rows > capacity 8
+    assert bool(state["preds"].overflowed)
+    with pytest.warns(UserWarning, match="CatBuffer overflowed"):
+        assert np.isnan(float(m.pure_compute(state)))
+
+
+def test_retrieval_collection_catbuffer_jit_compute():
+    from metrics_tpu import RetrievalCollection
+    from metrics_tpu.retrieval import RetrievalMRR, RetrievalPrecision
+
+    preds, target, idx = _retrieval_data()
+    eager = RetrievalCollection({"map": RetrievalMAP(), "mrr": RetrievalMRR(), "p": RetrievalPrecision(k=3)})
+    eager.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(idx))
+    expected = {k: float(v) for k, v in eager.compute().items()}
+
+    coll = RetrievalCollection(
+        {"map": RetrievalMAP(), "mrr": RetrievalMRR(), "p": RetrievalPrecision(k=3)},
+        num_queries=32,
+    ).with_capacity(512)
+    coll.update(jnp.asarray(preds), jnp.asarray(target), jnp.asarray(idx))
+    state = {k: v for k, v in coll._state.items()}
+    got = jax.jit(coll.pure_compute)(state)
+    for k, v in expected.items():
+        np.testing.assert_allclose(float(got[k]), v, atol=1e-6, err_msg=k)
+
+
+def test_overflowed_metric_hash_and_list_merge_policy():
+    """hash() must never raise, even overflowed; merging a corrupt CatBuffer
+    state INTO a list-state metric (which cannot carry the flag) must fail
+    with capacity-less advice."""
+    m = AUROC().with_capacity(BATCH_SIZE)
+    m.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    m.reset()
+    state = jax.jit(m.pure_update)(m.init_state(), jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+
+    def body(carry, batch):
+        p, t = batch
+        return m.pure_update(carry, p, t), None
+
+    state, _ = jax.lax.scan(body, state, (jnp.asarray(_preds[:2]), jnp.asarray(_target[:2])))
+    m._restore(state)
+    assert isinstance(hash(m), int)  # must not raise
+
+    m_list = AUROC()
+    m_list.update(jnp.asarray(_preds[3]), jnp.asarray(_target[3]))
+    with pytest.raises(MetricsTPUUserError, match="cannot be merged into a list-state"):
+        m_list.merge_states(m_list._state, state)
