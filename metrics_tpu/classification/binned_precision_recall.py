@@ -50,13 +50,15 @@ class BinnedPrecisionRecallCurve(Metric):
     recommended default on TPU.
 
     Instead of storing every score, the state is TP/FP/FN sum counters of
-    shape ``[C, T]``: update compares the batch against all thresholds at
-    once (dispatching to the hand-tiled pallas kernel on TPU backends,
-    hardware-proven bit-exact and faster than the fused-XLA fallback —
-    see BENCH.md config 6), so memory never grows with the stream, the
-    update is one fixed-shape jitted op, and distributed sync is a single
-    ``psum``. The price is curve resolution: precision/recall are exact
-    *at the chosen thresholds* rather than at every distinct score.
+    shape ``[C, T]``: update bins the batch against the thresholds via a
+    backend-aware mechanism (fused-XLA compare on TPU, bucket-histogram
+    elsewhere; a hand-tiled pallas kernel stays available via
+    ``ops.pallas_binned.binned_stat_scores(use_pallas=True)`` — all three
+    hardware-proven bit-exact, see BENCH.md row 6), so memory never grows
+    with the stream, the update is one fixed-shape jitted op, and
+    distributed sync is a single ``psum``. The price is curve resolution:
+    precision/recall are exact *at the chosen thresholds* rather than at
+    every distinct score.
 
     Args:
         num_classes: number of classes (1 for binary-style scores).
@@ -119,8 +121,9 @@ class BinnedPrecisionRecallCurve(Metric):
         if preds.ndim == target.ndim + 1:
             target = to_onehot(target, num_classes=self.num_classes)
         target = (target == 1).astype(jnp.float32)
-        # TPU: pallas kernel streaming [N, C] once through VMEM with [C, T]
-        # accumulators on-chip; elsewhere: fused-XLA broadcast compare
+        # bucket-histogram stats: each element bucketized once against the
+        # sorted thresholds instead of compared against all T of them
+        # (ops/pallas_binned.py; compare-path and pallas remain as opt-ins)
         tp, fp, fn = binned_stat_scores(preds, target, self.thresholds)
         self.TPs = self.TPs + tp
         self.FPs = self.FPs + fp

@@ -118,6 +118,15 @@ class RetrievalCollection(Metric):
         self.target.append(target)
 
     def compute(self) -> Dict[str, Array]:
+        """One grouping pass, every member metric scored off it.
+
+        An empty collection (no ``update`` yet) returns 0.0 for EVERY member —
+        including members constructed with ``empty_target_action='error'``,
+        whose error policy applies to empty *queries* within data, not to the
+        no-data case. This mirrors ``RetrievalMetric.compute``'s own
+        empty-state behavior (reference ``retrieval_metric.py:100-104``:
+        0-d default cat states compute straight through).
+        """
         from metrics_tpu.core.cat_buffer import CatBuffer
 
         state_preds = self._state["preds"]

@@ -1,8 +1,10 @@
-"""Pallas binned-stats kernel: parity vs the fused-XLA path.
+"""Binned-stats mechanisms: bucket-histogram default vs compare oracles.
 
-The kernel runs in interpreter mode here (tests are on the virtual CPU mesh);
-the compiled TPU path is exercised by the driver's bench runs. The XLA path
-itself is validated against sklearn through the BinnedPrecisionRecallCurve /
+Three mechanisms (ops/pallas_binned.py): the bucket-histogram default, the
+brute-force fused-XLA compare (the oracle here), and the opt-in pallas
+kernel (run in interpreter mode on the virtual CPU mesh; the compiled TPU
+path is exercised by the driver's bench runs). The XLA path itself is
+validated against sklearn through the BinnedPrecisionRecallCurve /
 BinnedAveragePrecision suites.
 """
 import jax.numpy as jnp
@@ -10,59 +12,123 @@ import numpy as np
 import pytest
 
 from metrics_tpu.ops.pallas_binned import (
+    _binned_stats_bucket,
     _binned_stats_xla,
     binned_stat_scores,
 )
 
+SHAPES = [
+    (37, 3, 100),  # nothing aligned to tiles
+    (256, 10, 5),  # tiny threshold count
+    (5, 1, 1),  # degenerate single class / single threshold
+    (1000, 17, 130),  # odd everything
+    (64, 130, 20),  # classes beyond one lane tile
+]
 
-@pytest.mark.parametrize(
-    "n,c,t",
-    [
-        (37, 3, 100),  # nothing aligned to tiles
-        (256, 10, 5),  # tiny threshold count
-        (5, 1, 1),  # degenerate single class / single threshold
-        (1000, 17, 130),  # odd everything
-        (64, 130, 20),  # classes beyond one lane tile
-    ],
-)
-def test_kernel_matches_xla_path(n, c, t):
-    rng = np.random.RandomState(42)
-    preds = jnp.asarray(rng.rand(n, c).astype(np.float32))
-    target = jnp.asarray((rng.rand(n, c) > 0.5).astype(np.float32))
-    thresholds = jnp.linspace(0, 1, t)
+
+def _data(n, c, t, seed=42, plant_ties=True):
+    rng = np.random.RandomState(seed)
+    thresholds = np.linspace(0, 1, t).astype(np.float32)
+    preds = rng.rand(n, c).astype(np.float32)
+    if plant_ties and n > 4:
+        # exact-threshold values: ties must classify identically everywhere
+        preds[: min(n // 4, t)] = thresholds[rng.randint(0, t, (min(n // 4, t), c))]
+    target = (rng.rand(n, c) > 0.5).astype(np.float32)
+    return jnp.asarray(preds), jnp.asarray(target), jnp.asarray(thresholds)
+
+
+@pytest.mark.parametrize("n,c,t", SHAPES)
+def test_bucket_path_bit_exact_vs_compare_oracle(n, c, t):
+    preds, target, thresholds = _data(n, c, t)
+    got = _binned_stats_bucket(preds, target, thresholds)
+    want = _binned_stats_xla(preds, target, thresholds)
+    for g, w, name in zip(got, want, ("tp", "fp", "fn")):
+        assert np.array_equal(np.asarray(g), np.asarray(w)), name
+
+
+@pytest.mark.parametrize("n,c,t", SHAPES)
+def test_pallas_kernel_matches_xla_path(n, c, t):
+    preds, target, thresholds = _data(n, c, t, plant_ties=False)
     got = binned_stat_scores(preds, target, thresholds, interpret=True)
     want = _binned_stats_xla(preds, target, thresholds)
     for g, w, name in zip(got, want, ("tp", "fp", "fn")):
         assert np.allclose(np.asarray(g), np.asarray(w)), name
 
 
-def test_kernel_threshold_boundary_semantics():
+def test_threshold_boundary_semantics():
     # elements exactly at a threshold count as positive predictions (>=),
-    # mirroring the reference's `preds >= thresholds` comparison
+    # mirroring the reference's `preds >= thresholds` comparison — on EVERY
+    # mechanism
     preds = jnp.asarray([[0.0], [0.5], [1.0]], dtype=jnp.float32)
     target = jnp.asarray([[1.0], [0.0], [1.0]])
     thresholds = jnp.asarray([0.0, 0.5, 1.0], dtype=jnp.float32)
-    tp, fp, fn = binned_stat_scores(preds, target, thresholds, interpret=True)
-    assert np.allclose(np.asarray(tp), [[2.0, 1.0, 1.0]])
-    assert np.allclose(np.asarray(fp), [[1.0, 1.0, 0.0]])
-    assert np.allclose(np.asarray(fn), [[0.0, 1.0, 1.0]])
+    for kwargs in ({}, {"use_pallas": False}, {"interpret": True}):
+        tp, fp, fn = binned_stat_scores(preds, target, thresholds, **kwargs)
+        assert np.allclose(np.asarray(tp), [[2.0, 1.0, 1.0]]), kwargs
+        assert np.allclose(np.asarray(fp), [[1.0, 1.0, 0.0]]), kwargs
+        assert np.allclose(np.asarray(fn), [[0.0, 1.0, 1.0]]), kwargs
 
 
-def test_dispatch_defaults_to_xla_off_tpu(monkeypatch):
-    # on the CPU test platform the auto path must pick XLA — assert the
-    # pallas kernel is NOT invoked (outputs alone can't tell: interpret-mode
-    # pallas produces identical values)
+def test_default_dispatch_is_bucket_and_never_pallas(monkeypatch):
+    """The pallas kernel is opt-in ONLY (measured ~parity with fused XLA,
+    BENCH.md row 6): the default dispatch must take the bucket path and
+    never auto-select pallas on any backend."""
     import metrics_tpu.ops.pallas_binned as mod
 
     def _boom(*a, **k):
-        raise AssertionError("pallas path must not run for use_pallas=None on CPU")
+        raise AssertionError("pallas path must not run unless use_pallas=True")
 
     monkeypatch.setattr(mod, "_binned_stats_pallas", _boom)
-    rng = np.random.RandomState(0)
-    preds = jnp.asarray(rng.rand(16, 4).astype(np.float32))
-    target = jnp.asarray((rng.rand(16, 4) > 0.5).astype(np.float32))
-    thresholds = jnp.linspace(0, 1, 10)
+    called = {"bucket": 0}
+    real_bucket = mod._binned_stats_bucket
+
+    def counting_bucket(*a, **k):
+        called["bucket"] += 1
+        return real_bucket(*a, **k)
+
+    monkeypatch.setattr(mod, "_binned_stats_bucket", counting_bucket)
+    preds, target, thresholds = _data(16, 4, 10)
     got = binned_stat_scores(preds, target, thresholds)
     want = _binned_stats_xla(preds, target, thresholds)
     for g, w in zip(got, want):
         assert np.allclose(np.asarray(g), np.asarray(w))
+    assert called["bucket"] == 1
+
+
+def test_unsorted_thresholds_fall_back_to_compare():
+    """searchsorted needs ascending thresholds; an unsorted user array must
+    keep compare semantics via the XLA path, not return garbage."""
+    rng = np.random.RandomState(7)
+    preds = jnp.asarray(rng.rand(64, 2).astype(np.float32))
+    target = jnp.asarray((rng.rand(64, 2) > 0.5).astype(np.float32))
+    unsorted = jnp.asarray([0.8, 0.1, 0.5], dtype=jnp.float32)
+    got = binned_stat_scores(preds, target, unsorted)
+    want = _binned_stats_xla(preds, target, unsorted)
+    for g, w in zip(got, want):
+        assert np.array_equal(np.asarray(g), np.asarray(w))
+
+
+def test_binned_metric_end_to_end_uses_bucket_path():
+    """BinnedPrecisionRecallCurve value is unchanged by the mechanism swap."""
+    from sklearn.metrics import precision_recall_curve  # noqa: F401 (env presence)
+
+    from metrics_tpu import BinnedAveragePrecision
+
+    rng = np.random.RandomState(3)
+    preds = rng.rand(512).astype(np.float32)
+    target = rng.randint(0, 2, 512)
+    m_new = BinnedAveragePrecision(num_classes=1, thresholds=101)
+    m_new.update(jnp.asarray(preds), jnp.asarray(target))
+    # oracle: same metric forced through the compare path
+    import metrics_tpu.ops.pallas_binned as mod
+
+    m_old = BinnedAveragePrecision(num_classes=1, thresholds=101)
+    tp, fp, fn = mod._binned_stats_xla(
+        jnp.asarray(preds).reshape(-1, 1),
+        jnp.asarray(target).reshape(-1, 1).astype(jnp.float32),
+        m_old.thresholds,
+    )
+    m_old.TPs, m_old.FPs, m_old.FNs = m_old.TPs + tp, m_old.FPs + fp, m_old.FNs + fn
+    np.testing.assert_array_equal(
+        np.asarray(m_new.compute()), np.asarray(m_old.compute())
+    )
