@@ -1,4 +1,4 @@
-.PHONY: test test-par test-fast doctest docs bench perf-smoke verify-pretrained clean
+.PHONY: test test-par test-fast test-ci test-nightly doctest docs bench perf-smoke verify-pretrained clean
 
 # Dev workflow targets (analogue of the reference's Makefile:1-28, minus the
 # network-dependent env/pip steps — this image is zero-egress).
@@ -21,6 +21,18 @@ test-par:
 # skip the slow marks (BERT jit, subprocess DDP, real-weight parity)
 test-fast:
 	python -m pytest tests/ -q -m "not slow"
+
+# CI suite: representative subset (nightly-marked exhaustive grids excluded)
+# under the reference's 45-min envelope + the skip budget, machine-checked
+# (scripts/suite_health.py; .github/workflows/ci.yml runs exactly this)
+test-ci:
+	METRICS_TPU_FUZZ_EXAMPLES=5 python scripts/suite_health.py --max-minutes 45 --max-skips 400 -- \
+		python -m pytest tests/ -q -m "not slow and not nightly"
+
+# nightly: the FULL matrix incl. slow marks, same health gate, wider envelope
+test-nightly:
+	python scripts/suite_health.py --max-minutes 180 --max-skips 400 -- \
+		python -m pytest tests/ -q
 
 # docstring examples across the package (also part of `make test` via
 # tests/test_doctests.py)

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Machine-checked suite-health gate (VERDICT r3 #5).
+
+Runs a pytest command, then asserts the three health invariants the
+reference's CI encodes in its pipeline config
+(``/root/reference/azure-pipelines.yml:22-30`` 45-min envelope;
+``.github/workflows/ci_test-full.yml`` matrix):
+
+- zero failures/errors,
+- wall time within the envelope,
+- skip count within budget (skips are annotated, tests/README.md, but the
+  budget stops the taxonomy from silently regrowing).
+
+Usage::
+
+    python scripts/suite_health.py --max-minutes 45 --max-skips 400 -- \
+        python -m pytest tests/ -q -m "not slow and not nightly"
+
+Exit code 0 only when every invariant holds; prints a one-line JSON verdict
+either way (consumed by CI logs and by BENCH.md's suite-health row).
+"""
+import argparse
+import json
+import re
+import subprocess
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max-minutes", type=float, required=True)
+    ap.add_argument("--max-skips", type=int, required=True)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER, help="-- then the pytest command")
+    args = ap.parse_args()
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        print("no command given", file=sys.stderr)
+        return 2
+
+    t0 = time.monotonic()
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    minutes = (time.monotonic() - t0) / 60.0
+    tail = (proc.stdout + proc.stderr)[-4000:]
+    sys.stdout.write(tail)
+
+    counts = {k: 0 for k in ("passed", "failed", "errors", "skipped")}
+    # pytest summary line: "4180 passed, 398 skipped, 3 warnings in 2400.00s"
+    for num, word in re.findall(r"(\d+) (passed|failed|error[s]?|skipped)", tail):
+        counts["errors" if word.startswith("error") else word] += int(num)
+
+    ok = (
+        proc.returncode == 0
+        and counts["failed"] == 0
+        and counts["errors"] == 0
+        and counts["passed"] > 0
+        and counts["skipped"] <= args.max_skips
+        and minutes <= args.max_minutes
+    )
+    print(json.dumps({
+        "suite_health": "ok" if ok else "FAILED",
+        "passed": counts["passed"],
+        "failed": counts["failed"] + counts["errors"],
+        "skipped": counts["skipped"],
+        "skip_budget": args.max_skips,
+        "minutes": round(minutes, 1),
+        "envelope_minutes": args.max_minutes,
+        "pytest_rc": proc.returncode,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

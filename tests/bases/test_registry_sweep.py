@@ -319,3 +319,81 @@ def test_grad_contract(name):
         assert np.any(grad != 0), f"{name} declares is_differentiable=True but grad is identically zero"
     else:
         assert not np.any(grad != 0), f"{name} declares is_differentiable=False but grad is nonzero"
+
+
+# ---------------------------------------------------------------------------
+# forward() merge contract: the build's core perf claim vs the reference's
+# double-update forward (reference metric.py:190-204) holds only when a
+# metric's state merges algebraically (`_can_merge`). This sweep proves no
+# shipped metric silently falls back to the 2x-update path.
+# ---------------------------------------------------------------------------
+
+# metrics allowed to take the double-update fallback, with reasons — EMPTY:
+# every exported metric merges. Additions require a written justification.
+FORWARD_FALLBACK_ALLOWED: dict = {}
+
+
+@pytest.mark.parametrize("name", sorted(REGISTRY), ids=sorted(REGISTRY))
+def test_forward_single_update_contract(name):
+    build, updates, opts = REGISTRY[name]
+    metric = build()
+    _run_updates(metric, updates, jnp.float32)  # warm input-mode detection
+    if name in FORWARD_FALLBACK_ALLOWED:
+        pytest.skip(f"documented fallback: {FORWARD_FALLBACK_ALLOWED[name]}")
+    assert metric._can_merge(), (
+        f"{name} cannot merge states: every forward() pays the reference's "
+        "double-update tax (metric.py:334-337). Override merge_states or add "
+        "a justified FORWARD_FALLBACK_ALLOWED entry."
+    )
+
+
+def test_forward_calls_update_exactly_once_when_mergeable():
+    """The mechanism behind the contract: a mergeable metric's forward runs
+    ONE update (batch value via fresh state + merge), not the reference's
+    accumulate-then-redo pair."""
+    calls = [0]
+
+    class Counting(M.Accuracy):
+        def update(self, *a, **k):
+            calls[0] += 1
+            return super().update(*a, **k)
+
+    m = Counting(num_classes=C)
+    assert m._can_merge()
+    m(jnp.asarray(_mc_prob), jnp.asarray(_mc_tgt))
+    assert calls[0] == 1, f"mergeable forward ran update {calls[0]}x (expected 1)"
+    m(jnp.asarray(_mc_prob), jnp.asarray(_mc_tgt))
+    assert calls[0] == 2
+    # and the accumulated value equals two plain updates (merge correctness)
+    ref = M.Accuracy(num_classes=C)
+    ref.update(jnp.asarray(_mc_prob), jnp.asarray(_mc_tgt))
+    ref.update(jnp.asarray(_mc_prob), jnp.asarray(_mc_tgt))
+    np.testing.assert_allclose(float(m.compute()), float(ref.compute()), atol=1e-7)
+
+
+def test_nonmergeable_custom_metric_still_falls_back_correctly():
+    """The fallback path stays correct for user metrics with a custom
+    reduction: forward's batch value and the accumulated compute both match
+    plain update semantics (at 2x update cost, like the reference)."""
+    calls = [0]
+
+    class Weird(Metric):
+        def __init__(self):
+            super().__init__(compute_on_step=True)
+            # product-reduction: no algebraic merge registered
+            self.add_state("acc_prod", jnp.ones(()), dist_reduce_fx=lambda x: jnp.prod(x, 0))
+
+        def update(self, x):
+            calls[0] += 1
+            self.acc_prod = self.acc_prod * jnp.mean(x)
+
+        def compute(self):
+            return self.acc_prod
+
+    m = Weird()
+    assert not m._can_merge()
+    v1 = m(jnp.asarray([2.0]))
+    np.testing.assert_allclose(float(v1), 2.0)
+    assert calls[0] == 2  # documented double-update fallback
+    m(jnp.asarray([3.0]))
+    np.testing.assert_allclose(float(m.compute()), 6.0)

@@ -75,6 +75,7 @@ class TestAccuracies(MetricTester):
             metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
         )
 
+    @pytest.mark.nightly  # full fixture breadth; CI runs the representative twin below
     def test_accuracy_sharded(self, preds, target, subset_accuracy):
         self.run_sharded_metric_test(
             preds=preds,
@@ -124,3 +125,17 @@ def test_accuracy_invalid_input():
         accuracy(jnp.asarray([1, 2]), jnp.asarray([0, 1]), average="not-an-average")
     with pytest.raises(ValueError):
         accuracy(jnp.asarray([1.0, 0.2]), jnp.asarray([0.0, 1.0]))  # float target
+
+
+def test_accuracy_sharded_ci_representative():
+    """CI twin of the nightly full-breadth sharded sweep: one probabilistic
+    and one subset-accuracy row through the real shard_map collective."""
+    t = MetricTester()
+    for inp, subset in ((_input_binary_prob, False), (_input_multilabel_prob, True)):
+        t.run_sharded_metric_test(
+            preds=inp.preds,
+            target=inp.target,
+            metric_class=Accuracy,
+            sk_metric=lambda p, tt, s=subset: _sk_accuracy(p, tt, s),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset},
+        )

@@ -65,6 +65,7 @@ class TestVariantAccuracy(MetricTester):
             metric_args={"threshold": THRESHOLD},
         )
 
+    @pytest.mark.nightly  # full fixture breadth; CI keeps a representative slice elsewhere
     def test_sharded(self, preds, target):
         self.run_sharded_metric_test(
             preds=preds,
@@ -198,3 +199,17 @@ def test_fid_sqrtm_method_validated_at_init():
 
     with pytest.raises(ValueError, match="unknown sqrtm method"):
         FID(feature=lambda x: x, feature_dim=8, streaming=True, sqrtm_method="newton")
+
+
+def test_sharded_ci_representative():
+    """CI twin of the nightly per-variant sharded sweep: one logit row and
+    the missing-class row through the real collective."""
+    t = MetricTester()
+    for inp in (_input_multiclass_logits, _input_multiclass_with_missing_class):
+        t.run_sharded_metric_test(
+            preds=inp.preds,
+            target=inp.target,
+            metric_class=Accuracy,
+            sk_metric=_sk_micro_accuracy,
+            metric_args={"threshold": THRESHOLD},
+        )

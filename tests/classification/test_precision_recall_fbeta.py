@@ -31,9 +31,10 @@ def _sk_wrap(sk_fn, preds, target, average, **kw):
     else:
         y_pred = preds
         binary = False
-    # the reference's "micro" on binary inputs scores the positive class only,
-    # which is sklearn's average='binary'
-    if binary and average == "micro":
+    # the reference's "micro" on binary inputs scores the positive class
+    # only, which is sklearn's average='binary'; macro/weighted over the
+    # single class collapse to the same score (r4: converted from skips)
+    if binary and average in ("micro", "macro", "weighted"):
         average = "binary"
     return sk_fn(target.ravel(), y_pred.ravel(), average=average, zero_division=0, **kw)
 
@@ -52,12 +53,19 @@ class TestPrecisionRecall(MetricTester):
 
     @staticmethod
     def _args(preds, average):
-        binary = preds.ndim == 2  # fixtures: [NB, B] = binary, [NB, B, C] = multiclass
-        if binary and average != "micro":
-            pytest.skip("macro/weighted on raw binary inputs is invalid reference API")
+        # fixtures: float [NB, B] = binary probs; int [NB, B] = multiclass
+        # labels; [NB, B, C] = multiclass probs. (The old ndim-2 test lumped
+        # multiclass LABELS in with binary and skipped their macro/weighted
+        # combos entirely — r4 fixed the detection and converted the skips.)
+        binary = preds.ndim == 2 and preds.dtype.kind == "f"
         args = {"average": average, "threshold": THRESHOLD}
         if not binary:
             args["num_classes"] = NUM_CLASSES
+        elif average != "micro":
+            # macro/weighted need an explicit class count; with one class
+            # they collapse to the positive-class score (r4: converted from
+            # "invalid reference API" skips — valid with num_classes=1)
+            args["num_classes"] = 1
         return args
 
     @pytest.mark.parametrize("ddp", [False, True])
@@ -98,6 +106,7 @@ class TestPrecisionRecall(MetricTester):
             metric_args={**self._args(preds, average), "beta": beta},
         )
 
+    @pytest.mark.nightly  # full fixture breadth; CI runs the representative twin below
     def test_f1_sharded(self, preds, target, average):
         self.run_sharded_metric_test(
             preds=preds, target=target, metric_class=F1,
@@ -148,3 +157,14 @@ def test_multilabel_micro_f1():
     # multilabel micro in the reference counts each label separately
     result = f1(jnp.asarray(preds), jnp.asarray(target), threshold=THRESHOLD)
     np.testing.assert_allclose(np.asarray(result), expected, atol=1e-6)
+
+
+def test_f1_sharded_ci_representative():
+    """CI twin of the nightly full-breadth sharded F1 sweep (macro row)."""
+    t = TestPrecisionRecall()
+    inp = _input_multiclass_prob
+    t.run_sharded_metric_test(
+        preds=inp.preds, target=inp.target, metric_class=F1,
+        sk_metric=lambda p, tt: _sk_wrap(fbeta_score, p, tt, "macro", beta=1.0),
+        metric_args=t._args(inp.preds, "macro"),
+    )

@@ -95,6 +95,26 @@ def test_default_dispatch_is_bucket_and_never_pallas(monkeypatch):
     assert called["bucket"] == 1
 
 
+def test_nan_preds_identical_across_mechanisms():
+    """NaN predictions are negative at every threshold (`pred >= thr` is
+    False for NaN); the bucket path must match — searchsorted would
+    otherwise place NaN past every threshold (positive everywhere)."""
+    preds = jnp.asarray([[jnp.nan], [0.5], [0.9]], dtype=jnp.float32)
+    target = jnp.asarray([[1.0], [1.0], [0.0]])
+    thresholds = jnp.asarray([0.0, 0.5, 1.0], dtype=jnp.float32)
+    want = _binned_stats_xla(preds, target, thresholds)
+    got = _binned_stats_bucket(preds, target, thresholds)
+    for g, w, name in zip(got, want, ("tp", "fp", "fn")):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w), err_msg=name)
+
+
+def test_contradictory_flags_raise():
+    preds = jnp.zeros((4, 2))
+    with pytest.raises(ValueError, match="contradictory"):
+        binned_stat_scores(preds, jnp.zeros((4, 2)), jnp.linspace(0, 1, 5),
+                           use_pallas=False, interpret=True)
+
+
 def test_unsorted_thresholds_fall_back_to_compare():
     """searchsorted needs ascending thresholds; an unsorted user array must
     keep compare semantics via the XLA path, not return garbage."""

@@ -113,6 +113,7 @@ class TestMeanErrorMatrix(MetricTester):
             metric_args=metric_args,
         )
 
+    @pytest.mark.nightly  # full fixture breadth; CI keeps a representative slice elsewhere
     def test_mean_error_sharded(
         self, preds, target, sk_wrapper, metric_class, metric_functional, sk_fn, metric_args
     ):

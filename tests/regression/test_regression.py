@@ -77,6 +77,7 @@ class TestMeanErrors(MetricTester):
             sk_metric=lambda p, t: sk_fn(t, p), metric_args=metric_args,
         )
 
+    @pytest.mark.nightly  # full fixture breadth; CI keeps a representative slice elsewhere
     def test_sharded(self, metric_class, metric_fn, sk_fn, metric_args):
         self.run_sharded_metric_test(
             preds=_preds, target=_target, metric_class=metric_class,
@@ -230,4 +231,13 @@ def test_pearson_sharded():
         metric_class=PearsonCorrcoef,
         sk_metric=lambda p, t: pearsonr(t.ravel(), p.ravel())[0],
         metric_args={},
+    )
+
+
+def test_sharded_ci_representative():
+    """CI twin of the nightly sharded mean-error sweep (MSE row)."""
+    t = TestMeanErrors()
+    t.run_sharded_metric_test(
+        preds=_preds, target=_target, metric_class=MeanSquaredError,
+        sk_metric=lambda p, tt: sk_mse(tt, p), metric_args={},
     )

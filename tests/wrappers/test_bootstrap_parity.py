@@ -48,8 +48,11 @@ class _CapturingBootStrapper(BootStrapper):
     [
         (partial(Precision, num_classes=10, average="micro"),
          partial(precision_score, average="micro"), _preds_cls, _target_cls),
-        (partial(Recall, num_classes=10, average="micro"),
-         partial(recall_score, average="micro"), _preds_cls, _target_cls),
+        # recall mirrors precision through the identical wrapper path —
+        # nightly keeps it, CI runs precision + mse
+        pytest.param(partial(Recall, num_classes=10, average="micro"),
+                     partial(recall_score, average="micro"), _preds_cls, _target_cls,
+                     marks=pytest.mark.nightly),
         (MeanSquaredError, mean_squared_error, _preds_reg, _target_reg),
     ],
     ids=["precision_micro", "recall_micro", "mse"],

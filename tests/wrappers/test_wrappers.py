@@ -97,7 +97,10 @@ def test_bootstrap_sampler_resamples_with_replacement(sampling_strategy):
 @pytest.mark.parametrize(
     "metric_ctor, data",
     [
-        (lambda: Accuracy(num_classes=4), "cls"),
+        # classification row compiles 20 bootstrap copies of a 4-class
+        # metric (~80 s on the CI host) — nightly; the regression row keeps
+        # the mean-tracking property in CI
+        pytest.param(lambda: Accuracy(num_classes=4), "cls", marks=pytest.mark.nightly),
         (lambda: MeanSquaredError(), "reg"),
     ],
 )
