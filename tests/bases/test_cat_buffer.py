@@ -695,3 +695,29 @@ def test_overflowed_metric_hash_and_list_merge_policy():
     m_list.update(jnp.asarray(_preds[3]), jnp.asarray(_target[3]))
     with pytest.raises(MetricsTPUUserError, match="cannot be merged into a list-state"):
         m_list.merge_states(m_list._state, state)
+
+
+def test_bool_buffer_dtype_survives_merge_and_sync():
+    """The contiguous-copy compaction must not promote bool buffers to int32
+    (a `jnp.where(mask, bool_arr, 0)` would): dtype changes mid-scan break
+    lax.scan carries and checkpoint round-trips."""
+    a = CatBuffer(4, buffer=jnp.zeros((4,), jnp.bool_), count=jnp.asarray(0, jnp.int32))
+    a = a.append(jnp.asarray([True, False]))
+    b = CatBuffer(4, buffer=jnp.zeros((4,), jnp.bool_), count=jnp.asarray(0, jnp.int32))
+    b = b.append(jnp.asarray([True]))
+    merged = a.merge(b)
+    assert merged.buffer.dtype == jnp.bool_
+    np.testing.assert_array_equal(np.asarray(merged.values()), [True, False, True])
+
+    world = 2
+    mesh = Mesh(np.array(jax.devices()[:world]), ("dp",))
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=P("dp"), out_specs=P(), check_vma=False)
+    def f(x):
+        cb = CatBuffer(4, buffer=jnp.zeros((4,), jnp.bool_), count=jnp.asarray(0, jnp.int32))
+        cb = cb.append(x[0, :2] > 0.5)
+        return sync_cat_buffer_in_jit(cb, "dp")
+
+    out = f(jnp.asarray([[0.9, 0.1, 0.0, 0.0], [0.2, 0.8, 0.0, 0.0]]))
+    assert out.buffer.dtype == jnp.bool_
+    np.testing.assert_array_equal(np.asarray(out.values()), [True, False, False, True])
