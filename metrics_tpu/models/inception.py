@@ -362,6 +362,9 @@ def inception_v3_apply(
         x = x * 2.0 - 1.0
         avg_a = avg_c = pool_e1 = pool_e2 = _avg_pool_same
 
+    # preprocessing stays float32 for exactness; the CNN runs in the params'
+    # compute dtype (bfloat16 on TPU halves HBM traffic and feeds the MXU)
+    x = x.astype(params["Conv2d_1a_3x3"]["kernel"].dtype)
     x = _basic_conv(params["Conv2d_1a_3x3"], x, stride=(2, 2))
     x = _basic_conv(params["Conv2d_2a_3x3"], x)
     x = _basic_conv(params["Conv2d_2b_3x3"], x, padding=((1, 1), (1, 1)))

@@ -355,3 +355,23 @@ def test_inception_v3_taps():
     assert out["2048"].shape == (1, 2048)
     assert out["logits_unbiased"].shape == (1, 1008)
     assert np.isfinite(np.asarray(out["2048"])).all()
+
+
+def test_bfloat16_extractor_runs_and_tracks_float32():
+    """The dtype knob must actually run (preprocessing is float32, so the
+    CNN input needs a cast to the params dtype — a conv dtype mismatch here
+    went uncaught until r4) and stay close to the f32 features. Pure-JAX:
+    deliberately NOT in the torch-gated parity module so a torch-less
+    image still runs it."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from metrics_tpu.models.inception import InceptionFeatureExtractor
+
+    rng = np.random.RandomState(77)
+    imgs = jnp.asarray(rng.randint(0, 256, (2, 3, 64, 64)).astype(np.uint8))
+    f32 = InceptionFeatureExtractor(feature=64)(imgs)
+    bf16 = InceptionFeatureExtractor(feature=64, dtype=jnp.bfloat16)(imgs)
+    assert bf16.dtype == jnp.float32  # features are returned re-promoted
+    assert np.isfinite(np.asarray(bf16)).all()
+    np.testing.assert_allclose(np.asarray(bf16), np.asarray(f32), atol=0.15, rtol=0.15)
