@@ -2,10 +2,13 @@
 
 The first config-7 run (2026-07-31 11:41Z window) measured 68.6% overhead
 against the <1% BASELINE.md target; this dissection showed every component's
-marginal cost sits at the noise floor, which led to the interleaved
-`_time_scan_step_pair` methodology now used by `bench_config7` (0.94%
-direct). Kept as a diagnostic: it reruns the same scan-slope timing with
-each metric enabled in isolation:
+marginal cost sits at the noise floor, which led first to interleaved slope
+timing (r3, 0.94% direct) and then to the r4 paired-slope method now used
+by `bench_config7` (`bench._paired_slope_pair`: slope cancels the per-call
+tunnel constant, within-rep rotation cancels drift). NOTE this diagnostic
+still uses plain sequential scan-slope per component — fine for
+attribution-at-noise-floor checks, NOT for quantitative ratios; trust the
+bench's paired-slope number:
 
     fwd_only | +fid | +acc | +auroc | +all
 
