@@ -240,6 +240,74 @@ def _paired_slope_pair(step_a, step_b, state0, k1: int, k2: int, reps: int = 20)
     return (per_a, per_b), compile_s, overheads
 
 
+def _device_step_us(steps, state0, k: int, execs: int = 8):
+    """Per-step DEVICE-TIMELINE microseconds for each named step fn — the r5
+    method of record for sub-ms programs (VERDICT r4 tasks 1+3).
+
+    Builds a K-step ``lax.scan`` per step fn, warms/compiles OUTSIDE the
+    trace, then executes all programs round-robin under ONE
+    ``jax.profiler`` trace and reads each execution's duration from the
+    *device* timeline (`metrics_tpu/utils/device_trace.py`). Wall clocks
+    never enter the number, so host dispatch and tunnel drift cannot bias
+    it (the r4 retraction class), and the trace's sub-µs event resolution
+    over K steps resolves signals the wall-clock spread could only bound.
+
+    Step names must be unique — device events are matched by the jitted
+    function's name. Returns (median_us_per_step, all_us_per_step,
+    jitted_programs, compile_seconds). Raises if the backend records no
+    device timeline; callers fall back to wall-clock slope.
+    """
+    import jax
+    from jax import lax
+
+    from metrics_tpu.utils.device_trace import measure_device_time_us
+
+    progs = {}
+    compile_s = 0.0
+    for name, step in steps.items():
+
+        def run(s0, _step=step):
+            return lax.scan(lambda s, _: (_step(s), None), s0, None, length=k)[0]
+
+        run.__name__ = name
+        fn = jax.jit(run)
+        t0 = time.perf_counter()
+        _fetch_scalar(fn(state0))
+        compile_s += time.perf_counter() - t0
+        progs[name] = fn
+
+    res = measure_device_time_us(
+        {n: (lambda _fn=fn: _fn(state0)) for n, fn in progs.items()}, execs=execs
+    )
+    med = {n: m / k for n, (m, _) in res.items()}
+    alls = {n: [d / k for d in durs] for n, (_, durs) in res.items()}
+    return med, alls, progs, compile_s
+
+
+def _program_flops(jitted, *args):
+    """FLOPs of one execution of a jitted program via XLA cost analysis."""
+    ca = jitted.lower(*args).compile().cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    f = ca.get("flops")
+    return float(f) if f else None
+
+
+def _peak_flops_bf16(device_kind: str):
+    """Per-chip bf16 peak FLOP/s for MFU denominators (public specs)."""
+    table = {
+        "TPU v5 lite": 197e12,  # v5e
+        "TPU v5e": 197e12,
+        "TPU v4": 275e12,
+        "TPU v5p": 459e12,
+        "TPU v6 lite": 918e12,  # v6e/Trillium
+    }
+    for k, v in table.items():
+        if device_kind.startswith(k):
+            return v
+    return None
+
+
 def _time_repeat_compute(compute_fn, state, perturb, k1: int = 2, k2: int = 10):
     """Per-call seconds of a jittable compute by slope, defeating CSE.
 
@@ -281,7 +349,12 @@ def _time_repeat_compute(compute_fn, state, perturb, k1: int = 2, k2: int = 10):
 
 
 def bench_ours() -> float:
-    """Config 1: Accuracy + StatScores fused update step (on-chip)."""
+    """Config 1: Accuracy + StatScores fused update step (on-chip).
+
+    Primary: device-timeline per-step time (no dispatch, no tunnel, sub-µs
+    resolution — resolves the r4 "value == resolution" upper bound into a
+    measurement). Wall-clock slope is kept as the cross-check diagnostic.
+    """
     import jax.numpy as jnp
 
     from metrics_tpu import Accuracy, MetricCollection, StatScores
@@ -292,9 +365,40 @@ def bench_ours() -> float:
     rng = np.random.RandomState(0)
     preds = jnp.asarray(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
     target = jnp.asarray(rng.randint(0, NUM_CLASSES, (BATCH,)))
+    step = lambda s: mc.pure_update(s, preds, target)  # noqa: E731
+
+    try:
+        med, alls, progs, compile_s = _device_step_us(
+            {"cfg1_fused_step": step}, mc.init_state(), k=2048, execs=8
+        )
+        per = np.array(alls["cfg1_fused_step"])
+        vals = mc.pure_compute(progs["cfg1_fused_step"](mc.init_state()))
+        assert np.isfinite(float(np.asarray(vals["acc"]))), "bench produced non-finite metric"
+        # wall-clock slope cross-check (the r2-r4 method)
+        wall_us = None
+        try:
+            wall, _, wall_res, _ = _time_scan_step(step, mc.init_state(), k1=500, k2=4000)
+            wall_us = {"slope_us": round(wall * 1e6, 2), "resolution_us": round(wall_res * 1e6, 2)}
+        except Exception as e:  # noqa: BLE001
+            wall_us = {"error": str(e)[:120]}
+        _diag(
+            config=1,
+            method="device-trace,k=2048,execs=8",
+            compile_s=round(compile_s, 1),
+            device_us_per_step=round(float(med["cfg1_fused_step"]), 4),
+            device_iqr_us=[
+                round(float(np.percentile(per, 25)), 4),
+                round(float(np.percentile(per, 75)), 4),
+            ],
+            resolution_us=round(float(np.percentile(per, 75) - np.percentile(per, 25)), 4),
+            wall_cross_check=wall_us,
+        )
+        return float(med["cfg1_fused_step"]) * 1e-6
+    except Exception as e:  # noqa: BLE001 — no device timeline: wall-clock fallback
+        _diag(config=1, device_trace_fallback=str(e)[:200])
 
     per_step, compile_s, resolution, final = _time_scan_step(
-        lambda s: mc.pure_update(s, preds, target), mc.init_state(), k1=500, k2=4000
+        step, mc.init_state(), k1=500, k2=4000
     )
     vals = mc.pure_compute(final)
     assert np.isfinite(float(np.asarray(vals["acc"]))), "bench produced non-finite metric"
@@ -352,17 +456,35 @@ def bench_config2() -> None:
     mc.update(preds, target)  # warm eager mode detection
 
     state0 = mc.pure_update(mc.init_state(), preds, target)  # 1 row block in
-    k1, k2 = 255, steps_cap - 1
-    per_step, compile_s, resolution, final = _time_scan_step(
-        lambda s: mc.pure_update(s, preds, target), state0, k1=k1, k2=k2
-    )
+    step = lambda s: mc.pure_update(s, preds, target)  # noqa: E731
+    per_step = resolution = None
+    try:
+        # device-timeline measurement: the K-step scan's device duration has
+        # sub-µs resolution, so the CatBuffer append step gets a NUMBER where
+        # the r4 wall-clock spread could only give a 6x-disagreeing bound
+        med, alls, progs, compile_s = _device_step_us(
+            {"cfg2_append_step": step}, state0, k=steps_cap - 1, execs=8
+        )
+        per = np.array(alls["cfg2_append_step"])
+        per_step = float(med["cfg2_append_step"]) * 1e-6
+        resolution = float(np.percentile(per, 75) - np.percentile(per, 25)) * 1e-6
+        final = progs["cfg2_append_step"](state0)
+        _diag(config=2, method="device-trace,k=2047,execs=8",
+              compile_s=round(compile_s, 1),
+              device_us_per_step=round(float(med["cfg2_append_step"]), 4),
+              device_iqr_us=[round(float(np.percentile(per, 25)), 4),
+                             round(float(np.percentile(per, 75)), 4)])
+    except Exception as e:  # noqa: BLE001
+        _diag(config=2, device_trace_fallback=str(e)[:200])
+        k1, k2 = 255, steps_cap - 1
+        per_step, compile_s, resolution, final = _time_scan_step(step, state0, k1=k1, k2=k2)
+        upper_bound = per_step < resolution
+        _diag(config=2, compile_s=round(compile_s, 1), upper_bound=upper_bound,
+              resolution_us=round(resolution * 1e6, 2))
     n_rows = int(np.asarray(final["auroc"]["preds"].count))
     assert n_rows == batch * steps_cap, f"CatBuffer row count {n_rows} != capacity {batch * steps_cap}"
     val = mc.pure_compute(final)
     assert np.isfinite(float(np.asarray(val["auroc"])))
-    upper_bound = per_step < resolution
-    _diag(config=2, compile_s=round(compile_s, 1), upper_bound=upper_bound,
-          resolution_us=round(resolution * 1e6, 2))
 
     # reference mechanism, torch-CPU: AUROC keeps growing python-list cat
     # states (classification/auroc.py cat states) and ConfusionMatrix does a
@@ -407,7 +529,11 @@ def bench_config2() -> None:
         bufs = jnp.asarray(rng.rand(W, cap).astype(np.float32))
         counts = jnp.asarray(rng.randint(cap // 2, cap, (W,)), jnp.int32)
 
-        def compaction(bufs):
+        # counts is a jitted ARGUMENT (not a closed-over constant), so the
+        # cumsum offsets stay runtime values and the measured compaction
+        # matches the shipped sync_cat_buffer_in_jit program, where offsets
+        # are data-dependent (ADVICE r4)
+        def cfg2_compaction(bufs, counts):
             new_cap = W * cap
             offsets = jnp.cumsum(counts) - counts
             out = jnp.zeros((new_cap,), jnp.float32)
@@ -416,9 +542,26 @@ def bench_config2() -> None:
             valid = jnp.arange(new_cap) < jnp.sum(counts)
             return jnp.where(valid, out, 0.0)
 
-        per_call, c_s, _ = _time_repeat_compute(
-            lambda b: compaction(b), bufs, lambda b, i: b + i * 1e-9, k1=1, k2=4
-        )
+        import jax
+
+        jitted = jax.jit(cfg2_compaction)
+        t0 = time.perf_counter()
+        jax.block_until_ready(jitted(bufs, counts))
+        c_s = time.perf_counter() - t0
+        try:
+            from metrics_tpu.utils.device_trace import measure_device_time_us
+
+            res = measure_device_time_us(
+                {"cfg2_compaction": lambda: jitted(bufs, counts)}, execs=10
+            )
+            per_call = res["cfg2_compaction"][0] * 1e-6
+            _diag(config=2, compaction_method="device-trace,execs=10")
+        except Exception:  # noqa: BLE001 — wall-clock fallback
+            per_call, extra_s, _ = _time_repeat_compute(
+                lambda s: cfg2_compaction(*s), (bufs, counts),
+                lambda s, i: (s[0] + i * 1e-9, s[1]), k1=1, k2=4,
+            )
+            c_s += extra_s
         bytes_per_dev = cap * 4 * 2  # preds f32 + target (i32) cat states
         ici_s = (W - 1) / W * bytes_per_dev / 45e9
         _diag(
@@ -438,7 +581,14 @@ def bench_config2() -> None:
 
 def bench_config3() -> None:
     """Config 3: FID — Inception-v3 forward + streaming moments on device,
-    and the compute (Newton–Schulz trace sqrtm on TPU) timed steady-state."""
+    and the compute (Newton–Schulz trace sqrtm on TPU) timed steady-state.
+
+    r5 adds the ABSOLUTE utilization story (VERDICT r4 task 2): per-step
+    device time + XLA cost-analysis FLOPs give achieved FLOP/s, reported as
+    MFU against the chip's published bf16 peak — for the shipping f32
+    extractor and the bf16 compute-dtype path (`InceptionFeatureExtractor
+    (dtype=bfloat16)`, the TPU-recommended configuration).
+    """
     import jax
     import jax.numpy as jnp
 
@@ -450,10 +600,25 @@ def bench_config3() -> None:
     imgs = jnp.asarray(rng.rand(batch, 3, 299, 299).astype(np.float32))
 
     state0 = fid.pure_update(fid.init_state(), imgs, True)
-    per_step, compile_s, resolution, final = _time_scan_step(
-        lambda s: fid.pure_update(s, imgs, True), state0, k1=4, k2=36
-    )
-    per_step = max(per_step, resolution)
+    update_step = lambda s: fid.pure_update(s, imgs, True)  # noqa: E731
+
+    per_step = None
+    try:
+        med, alls, progs, compile_s = _device_step_us(
+            {"cfg3_fid_update": update_step}, state0, k=16, execs=8
+        )
+        per_step = float(med["cfg3_fid_update"]) * 1e-6
+        final = progs["cfg3_fid_update"](state0)
+        _diag(config=3, method="device-trace,k=16,execs=8",
+              update_compile_s=round(compile_s, 1),
+              device_ms_per_step=round(float(med["cfg3_fid_update"]) / 1e3, 3))
+    except Exception as e:  # noqa: BLE001
+        _diag(config=3, device_trace_fallback=str(e)[:200])
+        per_step, compile_s, resolution, final = _time_scan_step(
+            update_step, state0, k1=4, k2=36
+        )
+        per_step = max(per_step, resolution)
+        _diag(config=3, update_compile_s=round(compile_s, 1))
     final = fid.pure_update(final, imgs, False)
 
     def perturb(state, i):
@@ -463,9 +628,51 @@ def bench_config3() -> None:
 
     per_call, compute_compile_s, val = _time_repeat_compute(fid.pure_compute, final, perturb)
     assert np.isfinite(float(np.asarray(val)))
-    _diag(config=3, update_compile_s=round(compile_s, 1), compute_compile_s=round(compute_compile_s, 1))
+    _diag(config=3, compute_compile_s=round(compute_compile_s, 1))
     _emit("fid_inception_forward", round(batch / per_step, 1), "imgs/s")
     _emit("fid_compute_sqrtm", round(per_call, 3), "s")
+
+    # ---- MFU: bare extractor forward, f32 vs bf16 compute dtype ---------
+    try:
+        from metrics_tpu.models.inception import InceptionFeatureExtractor
+
+        kind = jax.devices()[0].device_kind
+        peak = _peak_flops_bf16(kind)
+        for tag, dtype, b in (
+            ("f32", jnp.float32, batch),
+            ("bf16", jnp.bfloat16, batch),
+            ("bf16_b256", jnp.bfloat16, 256),
+        ):
+            ext = InceptionFeatureExtractor(feature=2048, dtype=dtype)
+            x = jnp.asarray(rng.rand(b, 3, 299, 299).astype(np.float32))
+
+            def fwd_step(chk, _ext=ext, _x=x):
+                f = _ext(_x + chk * 1e-24)
+                return chk + f.astype(jnp.float32).sum() * 1e-12
+
+            name = f"cfg3_fwd_{tag}"
+            med, alls, progs, c_s = _device_step_us(
+                {name: fwd_step}, jnp.zeros(()), k=8, execs=6
+            )
+            # FLOPs from a single-forward program: cost_analysis of a scanned
+            # while-loop may count the body once, so don't divide the scan's
+            flops_per_step = _program_flops(jax.jit(lambda y, _e=ext: _e(y)), x)
+            step_us = float(med[name])
+            achieved = flops_per_step / (step_us * 1e-6) if flops_per_step else None
+            mfu = 100.0 * achieved / peak if (achieved and peak) else None
+            _diag(config=3, fwd=tag, batch=b, device_kind=kind,
+                  device_ms_per_fwd=round(step_us / 1e3, 3),
+                  imgs_per_s=round(b / (step_us * 1e-6), 1),
+                  gflops_per_fwd=round(flops_per_step / 1e9, 2) if flops_per_step else None,
+                  achieved_tflops=round(achieved / 1e12, 2) if achieved else None,
+                  peak_bf16_tflops=round(peak / 1e12, 1) if peak else None,
+                  compile_s=round(c_s, 1))
+            if tag != "f32" and mfu is not None:
+                _emit(f"inception_fwd_mfu_{tag}", round(mfu, 1), "percent_of_bf16_peak")
+            elif mfu is not None:
+                _diag(config=3, f32_mfu_vs_bf16_peak=round(mfu, 1))
+    except Exception as e:  # noqa: BLE001 — MFU rows are additive evidence
+        _diag(config=3, mfu_error=f"{type(e).__name__}: {e}"[:300])
 
 
 def bench_config4() -> None:
@@ -496,6 +703,67 @@ def bench_config4() -> None:
     dt = sorted(ts)[len(ts) // 2]
     _diag(config=4, compile_s=round(first - dt, 1))
     _emit("bertscore_compute", round(4 * sents_per_batch / dt, 1), "sentences/s")
+
+    # ---- encoder MFU (VERDICT r4 task 2): device time + cost-analysis ----
+    # FLOPs for (a) the DEFAULT BERTScore encoder (tiny: hidden 128 x 4
+    # layers — expected low MFU, the matmuls are too small to fill the MXU;
+    # that is a model-size roofline fact, not framework overhead) and (b) a
+    # BERT-base-shaped encoder in bf16, the realistic heavy-forward shape.
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from metrics_tpu.models.bert import BertConfig, bert_apply, bert_init
+
+        kind = jax.devices()[0].device_kind
+        peak = _peak_flops_bf16(kind)
+        L = 64
+        rng = np.random.RandomState(0)
+        ids = jnp.asarray(rng.randint(0, 30000, (sents_per_batch, L)))
+        mask = jnp.ones((sents_per_batch, L), jnp.int32)
+        shapes = {
+            "tiny_default": (BertConfig(), jnp.float32),
+            "base_bf16": (
+                BertConfig(hidden_size=768, num_hidden_layers=12,
+                           num_attention_heads=12, intermediate_size=3072),
+                jnp.bfloat16,
+            ),
+        }
+        for tag, (cfg, dtype) in shapes.items():
+            params = bert_init(cfg)
+            if dtype != jnp.float32:
+                params = jax.tree_util.tree_map(
+                    lambda a: a.astype(dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                    params,
+                )
+
+            def enc_step(chk, _p=params, _c=cfg):
+                hidden = bert_apply(_p, ids, mask, config=_c)
+                return chk + hidden[-1].astype(jnp.float32).sum() * 1e-12
+
+            name = f"cfg4_enc_{tag}"
+            med, alls, progs, c_s = _device_step_us(
+                {name: enc_step}, jnp.zeros(()), k=8, execs=6
+            )
+            flops = _program_flops(
+                jax.jit(lambda i, m, _p=params, _c=cfg: bert_apply(_p, i, m, config=_c)[-1]),
+                ids, mask,
+            )
+            step_us = float(med[name])
+            achieved = flops / (step_us * 1e-6) if flops else None
+            mfu = 100.0 * achieved / peak if (achieved and peak) else None
+            _diag(config=4, encoder=tag, device_kind=kind, seq_len=L,
+                  batch=sents_per_batch,
+                  device_ms_per_fwd=round(step_us / 1e3, 3),
+                  sents_per_s_device=round(sents_per_batch / (step_us * 1e-6), 1),
+                  gflops_per_fwd=round(flops / 1e9, 2) if flops else None,
+                  achieved_tflops=round(achieved / 1e12, 2) if achieved else None,
+                  peak_bf16_tflops=round(peak / 1e12, 1) if peak else None,
+                  compile_s=round(c_s, 1))
+            if mfu is not None:
+                _emit(f"bert_encoder_mfu_{tag}", round(mfu, 1), "percent_of_bf16_peak")
+    except Exception as e:  # noqa: BLE001 — MFU rows are additive evidence
+        _diag(config=4, mfu_error=f"{type(e).__name__}: {e}"[:300])
 
 
 def bench_config5() -> None:
@@ -663,30 +931,68 @@ def bench_config7() -> None:
     """North star (BASELINE.md): metric overhead < 1% of forward-pass time in
     an eval loop running FID + Accuracy + AUROC together.
 
-    Measures the SAME eval loop twice — model forward only vs model forward
-    + all three metric updates fused into the step — with the paired-slope
-    method (`_paired_slope_pair`): slope over two scan lengths cancels the
-    per-call tunnel constant, the within-rep rotation cancels chip drift,
-    and the median of per-rep overheads (IQR reported) is the estimator."""
+    r5 method of record (VERDICT r4 task 1): DEVICE-TIMELINE timing. Both
+    programs — model forward only, and model forward + all three metric
+    updates fused into the step — are K-step scans executed round-robin
+    under one jax.profiler trace; each execution's duration is read from
+    the device timeline, which dispatch cost and tunnel drift cannot reach.
+    Per-rotation pairing gives an overhead distribution (median + IQR), and
+    the whole trace is run TWICE (independent captures) for reproduction.
+    The r4 paired-slope wall-clock method stays as a cross-check."""
     cfg = build_config7_loop()
     state0, on_tpu = cfg["state0"], cfg["on_tpu"]
+    base_step = cfg["make_step"](False, False, False)
+    full_step = cfg["make_step"](True, True, True)
+
+    device_ok = False
+    k = 24 if on_tpu else 4
+    try:
+        runs = []
+        for run_idx in (1, 2):
+            med, alls, progs, compile_s = _device_step_us(
+                {"cfg7_fwd": base_step, "cfg7_full": full_step},
+                state0, k=k, execs=10,
+            )
+            fwd = np.array(alls["cfg7_fwd"])
+            full = np.array(alls["cfg7_full"])
+            n = min(len(fwd), len(full))
+            ov = (full[:n] - fwd[:n]) / fwd[:n] * 100.0  # paired by rotation order
+            med_ov = float(np.median(ov))
+            p25, p75 = float(np.percentile(ov, 25)), float(np.percentile(ov, 75))
+            runs.append(med_ov)
+            _diag(config=7, method=f"device-trace,k={k},execs=10,run={run_idx}",
+                  fwd_device_ms=round(float(med["cfg7_fwd"]) / 1e3, 4),
+                  with_metrics_device_ms=round(float(med["cfg7_full"]) / 1e3, 4),
+                  overhead_pct=round(med_ov, 3),
+                  overhead_iqr=[round(p25, 3), round(p75, 3)],
+                  below_noise_floor=bool(p25 <= 0.0 <= p75),
+                  compile_s=round(compile_s, 1))
+        device_ok = True
+        overhead_pct = float(np.median(runs))
+    except Exception as e:  # noqa: BLE001
+        _diag(config=7, device_trace_fallback=str(e)[:200])
+
+    # wall-clock cross-check (r4 method of record); primary when no device
+    # timeline exists
     k1, k2 = (4, 28) if on_tpu else (2, 6)
     (base_s, full_s), compile_s, overheads = _paired_slope_pair(
-        cfg["make_step"](False, False, False),
-        cfg["make_step"](True, True, True),
-        state0, k1=k1, k2=k2, reps=20 if on_tpu else 3,
+        base_step, full_step, state0,
+        k1=k1, k2=k2, reps=(12 if device_ok else 20) if on_tpu else 3,
     )
     ov = np.array(overheads) * 100.0
-    overhead_pct = float(np.median(ov)) if ov.size else 0.0
+    wall_pct = float(np.median(ov)) if ov.size else 0.0
     p25 = float(np.percentile(ov, 25)) if ov.size else 0.0
     p75 = float(np.percentile(ov, 75)) if ov.size else 0.0
     _diag(config=7, fwd_ms=round(base_s * 1e3, 3),
           with_metrics_ms=round(full_s * 1e3, 3),
-          overhead_pct=round(overhead_pct, 2), compile_s=round(compile_s, 1),
-          method=f"paired-slope,k={k1}->{k2},reps={len(overheads)}",
+          overhead_pct=round(wall_pct, 2), compile_s=round(compile_s, 1),
+          method=f"paired-slope,k={k1}->{k2},reps={len(overheads)}"
+                 + (",cross-check" if device_ok else ""),
           overhead_iqr=[round(p25, 2), round(p75, 2)],
           # an IQR straddling zero means the median sits inside rep noise
           below_noise_floor=bool(p25 <= 0.0 <= p75))
+    if not device_ok:
+        overhead_pct = wall_pct
     overhead_pct = max(overhead_pct, 0.0)
     if not on_tpu:
         # the target is defined against an ACCELERATOR forward pass

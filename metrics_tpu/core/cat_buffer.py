@@ -39,14 +39,11 @@ import jax
 import jax.numpy as jnp
 from jax import Array, lax
 
+from metrics_tpu.utils.data import is_traced as _is_traced
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 
 __all__ = ["CatBuffer", "sync_cat_buffer_in_jit"]
-
-
-def _is_traced(x: Any) -> bool:
-    return isinstance(x, jax.core.Tracer)
 
 
 class CatBuffer:
@@ -161,9 +158,17 @@ class CatBuffer:
         (silent). Eagerly, a concrete overflow also emits a rank-zero
         warning pointing at ``with_capacity``. Reference list states never
         drop data (``metric.py:112-176``) — this is the TPU-native contract:
-        bounded memory, but corruption is always detectable."""
+        bounded memory, but corruption is always detectable.
+
+        Dtype: a floating ``value`` keeps its dtype. An integer ``value`` is
+        widened to float32 only when an overflow is *possible* (traced flag,
+        or concretely overflowed) — NaN needs a float carrier; when the flag
+        is concretely False the value passes through untouched (ADVICE r4).
+        """
         value = jnp.asarray(value)
-        if not _is_traced(self.overflowed) and bool(self.overflowed):
+        if not _is_traced(self.overflowed):
+            if not bool(self.overflowed):
+                return value
             rank_zero_warn(
                 f"CatBuffer overflowed (capacity {self.capacity}): compute returns "
                 "NaN. Construct the metric with a larger `with_capacity(...)`."

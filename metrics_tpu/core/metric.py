@@ -38,7 +38,7 @@ from metrics_tpu.parallel.sync import (
     jit_distributed_available,
     sync_in_jit,
 )
-from metrics_tpu.utils.data import apply_to_collection
+from metrics_tpu.utils.data import apply_to_collection, is_traced
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 from metrics_tpu.utils.prints import rank_zero_warn
 
@@ -543,7 +543,7 @@ class Metric:
                 # merging INTO a list state loses the overflow flag, so a
                 # corrupt buffer must fail here, loudly and with advice that
                 # fits a capacity-less metric (same policy as load_state_dict)
-                if not isinstance(b.overflowed, jax.core.Tracer) and bool(b.overflowed):
+                if not is_traced(b.overflowed) and bool(b.overflowed):
                     raise MetricsTPUUserError(
                         f"State {name!r} holds a CatBuffer that overflowed inside "
                         "jit: its rows are corrupt and cannot be merged into a "
@@ -983,7 +983,7 @@ def _wrap_compute(compute: Callable) -> Callable:
         from metrics_tpu.utils.checks import _tracing_active
 
         is_tracing = _tracing_active() or any(
-            isinstance(leaf, jax.core.Tracer) for leaf in jax.tree_util.tree_leaves(self._state)
+            is_traced(leaf) for leaf in jax.tree_util.tree_leaves(self._state)
         )
         should = self._to_sync and self._is_synced is False and not is_tracing
         if (

@@ -245,7 +245,9 @@ def binned_stat_scores(
     # on the HOST when concrete (a jnp.all here would stage into an ambient
     # trace and produce an unreadable tracer even for constants) and keep
     # compare semantics otherwise
-    if not isinstance(thresholds, jax.core.Tracer):
+    from metrics_tpu.utils.data import is_traced
+
+    if not is_traced(thresholds):
         import numpy as np
 
         thr = np.asarray(thresholds)

@@ -10,7 +10,10 @@ from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import Array
+
+from metrics_tpu.utils.data import is_traced
 
 
 class GroupedByQuery(NamedTuple):
@@ -48,6 +51,19 @@ def group_by_query(
         if num_groups is None:
             raise ValueError("`valid` masking needs a static `num_groups` bound")
         sentinel = jnp.iinfo(jnp.asarray(indexes).dtype).max
+        # iinfo.max is RESERVED as the padding sort key. A valid row carrying
+        # that id would share the key and sort among the padding block; its
+        # gid still comes from the valid-masked cumsum (so reductions stay
+        # correct), but the reliance is subtle — refuse loudly while the
+        # values are concrete enough to check (ADVICE r4).
+        if not is_traced(indexes) and not is_traced(valid):
+            clash = np.logical_and(np.asarray(valid), np.asarray(indexes) == sentinel)
+            if bool(np.any(clash)):
+                raise ValueError(
+                    f"query id {sentinel} (iinfo({jnp.asarray(indexes).dtype}).max) is "
+                    "reserved as the padding sentinel in `valid` mode; re-key the "
+                    "offending queries or use a wider index dtype."
+                )
         indexes = jnp.where(valid, indexes, sentinel)
         preds_key = jnp.where(valid, preds, -jnp.inf)
     else:
@@ -66,7 +82,7 @@ def group_by_query(
         gid = jnp.where(valid_s, gid, num_groups)
     if num_groups is None:
         num_groups = int(gid[-1]) + 1 if idx_s.size else 0
-    elif idx_s.size and not isinstance(gid, jax.core.Tracer):
+    elif idx_s.size and not is_traced(gid):
         # static bound with concrete data: gids are DENSE 0-based group ids
         # (cumsum of boundaries), so the bound constrains the number of
         # DISTINCT query ids, not their magnitude. Out-of-range groups would

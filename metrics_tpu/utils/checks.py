@@ -31,7 +31,9 @@ try:  # 161 ns trace-context check; private, so fall back to a probe op
 except ImportError:  # pragma: no cover - older/newer jax layout
 
     def _tracing_active() -> bool:
-        return isinstance(jnp.zeros(()) + 0, jax.core.Tracer)
+        from metrics_tpu.utils.data import is_traced
+
+        return is_traced(jnp.zeros(()) + 0)
 
 
 def _is_concrete(*arrays: Array) -> bool:
@@ -39,7 +41,9 @@ def _is_concrete(*arrays: Array) -> bool:
     ambient. The second condition matters for jit/scan over closure-constant
     inputs — the arguments look concrete, but any op on them binds to the
     ambient trace, so value-dependent validation would blow up on `int()`."""
-    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+    from metrics_tpu.utils.data import is_traced
+
+    if any(is_traced(a) for a in arrays):
         return False
     return not _tracing_active()
 

@@ -15,16 +15,22 @@ from jax import Array
 METRIC_EPS = 1e-6
 
 
+def is_traced(x: Any) -> bool:
+    """True when ``x`` is an abstract tracer (inside jit/scan/vmap tracing).
+
+    The single place the package touches ``jax.core.Tracer`` (an accessor
+    path newer JAX releases may move/deprecate) — every other site goes
+    through this helper so one edit absorbs a future API move (ADVICE r4).
+    """
+    return isinstance(x, jax.core.Tracer)
+
+
 def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
     """Concatenate a (possibly list- or CatBuffer-valued) state along dim 0."""
     from metrics_tpu.core.cat_buffer import CatBuffer
 
     if isinstance(x, CatBuffer):
-        import jax as _jax
-
-        if x.buffer is None or (
-            not isinstance(x.count, _jax.core.Tracer) and len(x) == 0
-        ):
+        if x.buffer is None or (not is_traced(x.count) and len(x) == 0):
             raise ValueError("No samples to concatenate")
         return x.values()
     x = list(x) if isinstance(x, (list, tuple)) else [x]

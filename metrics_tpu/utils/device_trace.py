@@ -1,0 +1,157 @@
+"""Device-side execution timing from ``jax.profiler`` traces.
+
+Wall clocks over a remote-TPU tunnel measure dispatch + network jitter as
+much as compute (BENCH.md's drift taxonomy); the profiler's chrome trace,
+by contrast, records every XLA program execution **on the device timeline**
+with sub-microsecond resolution. :class:`DeviceTrace` captures a trace and
+returns per-program device durations, so a per-step number excludes host
+dispatch and tunnel drift *entirely* — the round-5 methodology of record
+for BENCH configs 1/2/3/7.
+
+The parser reads the ``*.trace.json.gz`` chrome trace jax writes (pure
+gzip+json — no tensorflow/tensorboard dependency): complete events
+(``ph=="X"``) on pids whose ``process_name`` metadata starts with
+``/device:`` are device-side; a compiled program appears there as one
+top-level event named ``jit_<fn_name>(<fingerprint>)`` per execution, with
+``dur`` in microseconds (its fusions appear as separate nested events and
+are NOT double-counted — matching is by program name).
+
+The reference has no analogue (its only telemetry is a usage-logging call,
+reference ``metric.py:84``); this is part of the TPU build's
+tracing/profiling subsystem (SURVEY §5).
+"""
+import glob
+import gzip
+import json
+import os
+import shutil
+import tempfile
+from typing import Callable, Dict, List, Mapping, Optional, Tuple
+
+__all__ = ["DeviceTrace", "parse_device_events", "measure_device_time_us"]
+
+
+def parse_device_events(trace_dir: str) -> Dict[str, List[float]]:
+    """Parse every ``*.trace.json.gz`` under ``trace_dir``.
+
+    Returns ``{event_name: [duration_us, ...]}`` for complete events on
+    device pids only (process name ``/device:*``), durations in trace order.
+    """
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True)
+    )
+    if not paths:
+        raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")
+    out: Dict[str, List[float]] = {}
+    for path in paths:
+        with gzip.open(path, "rt") as fh:
+            data = json.load(fh)
+        events = data.get("traceEvents", [])
+        device_pids = {
+            e["pid"]
+            for e in events
+            if e.get("ph") == "M"
+            and e.get("name") == "process_name"
+            and str(e.get("args", {}).get("name", "")).startswith("/device:")
+        }
+        for e in events:
+            if e.get("ph") == "X" and e.get("pid") in device_pids:
+                out.setdefault(e["name"], []).append(float(e.get("dur", 0.0)))
+    return out
+
+
+def _program_durations(events: Dict[str, List[float]], program: str) -> List[float]:
+    """Durations of the top-level device event for jitted fn ``program``.
+
+    Matches ``jit_<program>`` exactly or with a ``(<fingerprint>)`` suffix —
+    never the program's nested fusion events.
+    """
+    exact = f"jit_{program}"
+    hits: List[float] = []
+    for name, durs in events.items():
+        if name == exact or name.startswith(exact + "("):
+            hits.extend(durs)
+    return hits
+
+
+class DeviceTrace:
+    """Context manager capturing a jax.profiler trace into a temp dir.
+
+    Usage::
+
+        with DeviceTrace() as dt:
+            run_base(state)   # jitted fns, already warmed
+            run_full(state)
+        base_us = dt.program_times_us("run_base")   # one entry per execution
+
+    ``keep_dir=True`` preserves the raw trace directory (``dt.trace_dir``)
+    for offline inspection; otherwise it is deleted on exit after parsing.
+    """
+
+    def __init__(self, keep_dir: bool = False):
+        self._keep = keep_dir
+        self.trace_dir: Optional[str] = None
+        self._events: Optional[Dict[str, List[float]]] = None
+
+    def __enter__(self) -> "DeviceTrace":
+        import jax
+
+        self.trace_dir = tempfile.mkdtemp(prefix="metrics_tpu_trace_")
+        jax.profiler.start_trace(self.trace_dir)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+            if exc_type is None:
+                self._events = parse_device_events(self.trace_dir)
+        finally:
+            if not self._keep and self.trace_dir:
+                shutil.rmtree(self.trace_dir, ignore_errors=True)
+
+    @property
+    def events(self) -> Dict[str, List[float]]:
+        if self._events is None:
+            raise RuntimeError("trace not finished — use within/after the `with` block")
+        return self._events
+
+    def program_times_us(self, program: str) -> List[float]:
+        """Per-execution device durations (µs) for jitted fn ``program``."""
+        return _program_durations(self.events, program)
+
+
+def measure_device_time_us(
+    programs: Mapping[str, Callable[[], object]],
+    execs: int = 4,
+) -> Dict[str, Tuple[float, List[float]]]:
+    """Run each (warmed, jitted) thunk ``execs`` times under ONE trace.
+
+    Thunks rotate round-robin so chip-state drift within the window hits
+    every program alike (the pairing idea from the wall-clock methodology,
+    BENCH.md r4). The key of ``programs`` must be the jitted function's
+    ``__name__`` — that is how its device events are found. Returns
+    ``{name: (median_us, all_durations_us)}`` per device execution.
+
+    Raises RuntimeError when a program produced no device events (e.g. a
+    CPU backend, which has no device timeline) — callers fall back to
+    wall-clock slope timing.
+    """
+    import jax
+    import numpy as np
+
+    with DeviceTrace() as dt:
+        for _ in range(execs):
+            for thunk in programs.values():
+                jax.block_until_ready(thunk())
+    out: Dict[str, Tuple[float, List[float]]] = {}
+    for name in programs:
+        durs = dt.program_times_us(name)
+        if not durs:
+            raise RuntimeError(
+                f"no device-timeline events for program {name!r} "
+                f"(device events seen: {sorted(dt.events)[:12]})"
+            )
+        out[name] = (float(np.median(durs)), durs)
+    return out

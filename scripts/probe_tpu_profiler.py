@@ -1,0 +1,54 @@
+"""One-shot probe: what device-time evidence can this TPU window give us?
+
+Run via subprocess with a timeout (tunnel may hang). Prints JSON lines:
+- device kind + platform
+- whether jax.profiler.trace writes an xplane file and its size
+- whether Compiled.cost_analysis() returns flops on this backend
+"""
+import glob
+import json
+import os
+import sys
+import tempfile
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    print(json.dumps({"platform": d.platform, "device_kind": d.device_kind,
+                      "jax_version": jax.__version__}))
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    x = jnp.ones((512, 512), jnp.float32)
+    lowered = jax.jit(lambda x: x @ x).lower(x)
+    compiled = lowered.compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        print(json.dumps({"cost_analysis_flops": ca.get("flops"),
+                          "cost_analysis_keys": sorted(ca)[:20]}))
+    except Exception as e:
+        print(json.dumps({"cost_analysis_error": f"{type(e).__name__}: {e}"[:300]}))
+
+    float(f(x))  # warm
+    td = tempfile.mkdtemp(prefix="jaxprof_")
+    try:
+        with jax.profiler.trace(td):
+            for _ in range(3):
+                float(f(x))
+        files = sorted(glob.glob(os.path.join(td, "**", "*"), recursive=True))
+        listing = [(os.path.relpath(p, td), os.path.getsize(p))
+                   for p in files if os.path.isfile(p)]
+        print(json.dumps({"trace_files": listing}))
+    except Exception as e:
+        print(json.dumps({"trace_error": f"{type(e).__name__}: {e}"[:300]}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Inspect what jax.profiler.trace records for a TPU program: xplane planes/
+lines and the chrome-trace event names, so the bench's device-time parser
+targets the right stream."""
+import glob
+import gzip
+import json
+import os
+import tempfile
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        for _ in range(4):
+            x = x @ x
+        return x
+
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    jax.block_until_ready(f(x))
+    td = tempfile.mkdtemp(prefix="jaxprof_")
+    with jax.profiler.trace(td):
+        for _ in range(3):
+            jax.block_until_ready(f(x))
+
+    tj = glob.glob(os.path.join(td, "**", "*.trace.json.gz"), recursive=True)[0]
+    with gzip.open(tj, "rt") as fh:
+        data = json.load(fh)
+    ev = data.get("traceEvents", [])
+    pids = {}
+    for e in ev:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pids[e["pid"]] = e["args"].get("name")
+    print("PROCESSES:", json.dumps(pids))
+    by_pid = {}
+    for e in ev:
+        if e.get("ph") == "X":
+            by_pid.setdefault(e["pid"], []).append(e)
+    for pid, evs in by_pid.items():
+        names = {}
+        for e in evs:
+            names.setdefault(e["name"], [0, 0.0])
+            names[e["name"]][0] += 1
+            names[e["name"]][1] += e.get("dur", 0)
+        top = sorted(names.items(), key=lambda kv: -kv[1][1])[:8]
+        print(f"PID {pid} ({pids.get(pid)}): {len(evs)} events; top:", json.dumps(top))
+
+    try:
+        from tensorflow.core.profiler.protobuf import xplane_pb2  # noqa: F401
+        xp = glob.glob(os.path.join(td, "**", "*.xplane.pb"), recursive=True)[0]
+        space = xplane_pb2.XSpace()
+        with open(xp, "rb") as fh:
+            space.ParseFromString(fh.read())
+        for plane in space.planes:
+            print("XPLANE:", plane.name, "lines:", [(l.name, len(l.events)) for l in plane.lines])
+    except Exception as e:
+        print("xplane parse failed:", type(e).__name__, str(e)[:200])
+
+
+if __name__ == "__main__":
+    main()

@@ -21,10 +21,31 @@ either way (consumed by CI logs and by BENCH.md's suite-health row).
 """
 import argparse
 import json
+import os
 import re
 import subprocess
 import sys
+import tempfile
 import time
+import xml.etree.ElementTree as ET
+
+
+def _counts_from_junitxml(path: str):
+    """Machine-readable counts (ADVICE r4: regex over a bounded output tail
+    could undercount when a long warnings footer truncates the summary)."""
+    root = ET.parse(path).getroot()
+    suites = [root] if root.tag == "testsuite" else list(root)
+    c = {"passed": 0, "failed": 0, "errors": 0, "skipped": 0}
+    for s in suites:
+        tests = int(s.get("tests", 0))
+        failures = int(s.get("failures", 0))
+        errors = int(s.get("errors", 0))
+        skipped = int(s.get("skipped", 0))
+        c["failed"] += failures
+        c["errors"] += errors
+        c["skipped"] += skipped
+        c["passed"] += max(tests - failures - errors - skipped, 0)
+    return c
 
 
 def main() -> int:
@@ -38,16 +59,35 @@ def main() -> int:
         print("no command given", file=sys.stderr)
         return 2
 
+    # counts come from pytest's junitxml (exact), not from scraping stdout
+    xml_path = None
+    if any("pytest" in part for part in cmd) and not any("--junitxml" in part for part in cmd):
+        fd, xml_path = tempfile.mkstemp(suffix=".xml", prefix="suite_health_")
+        os.close(fd)
+        cmd = cmd + [f"--junitxml={xml_path}"]
+
     t0 = time.monotonic()
     proc = subprocess.run(cmd, capture_output=True, text=True)
     minutes = (time.monotonic() - t0) / 60.0
     tail = (proc.stdout + proc.stderr)[-4000:]
     sys.stdout.write(tail)
 
-    counts = {k: 0 for k in ("passed", "failed", "errors", "skipped")}
-    # pytest summary line: "4180 passed, 398 skipped, 3 warnings in 2400.00s"
-    for num, word in re.findall(r"(\d+) (passed|failed|error[s]?|skipped)", tail):
-        counts["errors" if word.startswith("error") else word] += int(num)
+    counts = None
+    if xml_path:
+        try:
+            counts = _counts_from_junitxml(xml_path)
+        except Exception as e:  # noqa: BLE001 — fall back to the tail scrape
+            print(f"junitxml parse failed ({e}); falling back to tail scrape", file=sys.stderr)
+        finally:
+            try:
+                os.unlink(xml_path)
+            except OSError:
+                pass
+    if counts is None:
+        counts = {k: 0 for k in ("passed", "failed", "errors", "skipped")}
+        # pytest summary line: "4180 passed, 398 skipped, 3 warnings in 2400.00s"
+        for num, word in re.findall(r"(\d+) (passed|failed|error[s]?|skipped)", tail):
+            counts["errors" if word.startswith("error") else word] += int(num)
 
     ok = (
         proc.returncode == 0

@@ -1,0 +1,24 @@
+"""The driver-visible multi-process host-sync dryrun, run as a test.
+
+`__graft_entry__.dryrun_multihost` spawns 2 localhost ``jax.distributed``
+processes (4 virtual CPU devices each) and pushes every state family
+through the production ``compute()``-time host gather — the analogue of
+the reference's ``gather_all_tensors`` path
+(``torchmetrics/utilities/distributed.py:96-145``) — asserting against a
+single-process oracle. Keeping it green in CI means the driver artifact
+(`MULTIHOST_r*.json`) can never go stale silently.
+"""
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+
+@pytest.mark.slow
+def test_dryrun_multihost_ok(capsys):
+    from __graft_entry__ import dryrun_multihost
+
+    dryrun_multihost()
+    assert "dryrun_multihost ok" in capsys.readouterr().out

@@ -1,0 +1,111 @@
+"""Tests for the device-timeline trace parser (utils/device_trace.py).
+
+The parser itself is exercised against synthetic chrome traces in the exact
+layout jax.profiler writes (verified against a real v5e capture, BENCH.md
+r5 methodology); the capture path is exercised for real — on the CPU
+backend the trace exists but has no device timeline, which must surface as
+the documented RuntimeError (bench falls back to wall-clock slope there).
+"""
+import gzip
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.utils.device_trace import (
+    DeviceTrace,
+    measure_device_time_us,
+    parse_device_events,
+)
+
+
+def _write_trace(dirpath, events):
+    os.makedirs(os.path.join(dirpath, "plugins", "profile", "t1"), exist_ok=True)
+    path = os.path.join(dirpath, "plugins", "profile", "t1", "vm.trace.json.gz")
+    with gzip.open(path, "wt") as fh:
+        json.dump({"traceEvents": events}, fh)
+    return path
+
+
+def _meta(pid, name):
+    return {"ph": "M", "pid": pid, "name": "process_name", "args": {"name": name}}
+
+
+def _ev(pid, name, dur):
+    return {"ph": "X", "pid": pid, "name": name, "ts": 0, "dur": dur}
+
+
+class TestParseDeviceEvents:
+    def test_device_events_only(self, tmp_path):
+        """Host-pid events must not pollute the device timeline."""
+        _write_trace(str(tmp_path), [
+            _meta(3, "/device:TPU:0"), _meta(701, "/host:CPU"),
+            _ev(3, "jit_run(123)", 42.5), _ev(3, "jit_run(123)", 43.5),
+            _ev(3, "fusion.1", 10.0),
+            _ev(701, "PjitFunction(run)", 9000.0),
+        ])
+        ev = parse_device_events(str(tmp_path))
+        assert ev == {"jit_run(123)": [42.5, 43.5], "fusion.1": [10.0]}
+
+    def test_missing_trace_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            parse_device_events(str(tmp_path))
+
+    def test_program_matching_excludes_fusions_and_prefixes(self, tmp_path):
+        """jit_run must not match jit_run2's events or nested fusions."""
+        _write_trace(str(tmp_path), [
+            _meta(3, "/device:TPU:0"),
+            _ev(3, "jit_run(1)", 5.0),
+            _ev(3, "jit_run2(9)", 7.0),
+            _ev(3, "fusion", 1.0),
+        ])
+
+        dt = DeviceTrace()
+        dt._events = parse_device_events(str(tmp_path))
+        assert dt.program_times_us("run") == [5.0]
+        assert dt.program_times_us("run2") == [7.0]
+        assert dt.program_times_us("missing") == []
+
+    def test_multiple_capture_files_aggregate(self, tmp_path):
+        a, b = tmp_path / "a", tmp_path / "b"
+        for d in (a, b):
+            _write_trace(str(d), [_meta(3, "/device:TPU:0"), _ev(3, "jit_f(1)", 1.0)])
+        ev = parse_device_events(str(tmp_path))
+        assert ev["jit_f(1)"] == [1.0, 1.0]
+
+
+class TestCapture:
+    def test_cpu_backend_has_no_device_timeline(self):
+        """On the CPU platform the capture works but yields no device events
+        — measure_device_time_us must raise the documented RuntimeError so
+        bench.py falls back to wall-clock slope timing."""
+
+        @jax.jit
+        def run_devtrace_probe(x):
+            return (x * 2.0).sum()
+
+        x = jnp.ones((64,))
+        float(run_devtrace_probe(x))  # warm outside the trace
+        with pytest.raises(RuntimeError, match="no device-timeline events"):
+            measure_device_time_us(
+                {"run_devtrace_probe": lambda: run_devtrace_probe(x)}, execs=2
+            )
+
+    def test_trace_context_requires_exit(self):
+        dt = DeviceTrace()
+        with pytest.raises(RuntimeError, match="trace not finished"):
+            _ = dt.events
+
+    @pytest.mark.skipif(jax.default_backend() != "tpu", reason="needs a device timeline")
+    def test_tpu_end_to_end(self):  # pragma: no cover - hardware-only
+        @jax.jit
+        def run_e2e_probe(x):
+            return (x @ x).sum()
+
+        x = jnp.ones((256, 256))
+        float(run_e2e_probe(x))
+        res = measure_device_time_us({"run_e2e_probe": lambda: run_e2e_probe(x)}, execs=3)
+        med, durs = res["run_e2e_probe"]
+        assert med > 0 and len(durs) == 3
