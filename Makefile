@@ -13,8 +13,9 @@ test:
 
 # same suite fanned over 4 xdist workers (each worker gets its own 8-device
 # virtual mesh; the persistent compile cache handles concurrent writers).
-# measured: 71 min vs 79 min serial on the 8-core dev host — the win is
-# modest because the BERT/model long tail serializes; bigger hosts gain more
+# NOTE: only worth it on a multi-core host — the current 1-core dev host
+# gains nothing from xdist (historical r3 numbers on a since-retired 8-core
+# host: 71 min vs 79 min serial; the BERT/model long tail serializes)
 test-par:
 	python -m pytest tests/ -q -n 4
 

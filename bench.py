@@ -365,19 +365,34 @@ def bench_ours() -> float:
     rng = np.random.RandomState(0)
     preds = jnp.asarray(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
     target = jnp.asarray(rng.randint(0, NUM_CLASSES, (BATCH,)))
-    step = lambda s: mc.pure_update(s, preds, target)  # noqa: E731
 
+    # the carry-dependent epsilon (numerically nil at 1e-24) keeps the stat
+    # computation INSIDE the loop: with loop-invariant preds XLA's while-loop
+    # LICM may hoist the per-step one-hot/compare work and leave only the
+    # accumulator adds, which would undercount a real eval loop where every
+    # step sees fresh data (same guard as config 7's _step_inputs)
+    def step(state):
+        chk, s = state
+        new = mc.pure_update(s, preds + chk * 1e-24, target)
+        bump = sum(
+            jnp.sum(leaf.astype(jnp.float32)) for leaf in jax.tree_util.tree_leaves(new)
+        )
+        return (chk + bump * 1e-24, new)
+
+    import jax
+
+    state0 = (jnp.zeros(()), mc.init_state())
     try:
         med, alls, progs, compile_s = _device_step_us(
-            {"cfg1_fused_step": step}, mc.init_state(), k=2048, execs=8
+            {"cfg1_fused_step": step}, state0, k=2048, execs=8
         )
         per = np.array(alls["cfg1_fused_step"])
-        vals = mc.pure_compute(progs["cfg1_fused_step"](mc.init_state()))
+        vals = mc.pure_compute(progs["cfg1_fused_step"](state0)[1])
         assert np.isfinite(float(np.asarray(vals["acc"]))), "bench produced non-finite metric"
         # wall-clock slope cross-check (the r2-r4 method)
         wall_us = None
         try:
-            wall, _, wall_res, _ = _time_scan_step(step, mc.init_state(), k1=500, k2=4000)
+            wall, _, wall_res, _ = _time_scan_step(step, state0, k1=500, k2=4000)
             wall_us = {"slope_us": round(wall * 1e6, 2), "resolution_us": round(wall_res * 1e6, 2)}
         except Exception as e:  # noqa: BLE001
             wall_us = {"error": str(e)[:120]}
@@ -398,9 +413,9 @@ def bench_ours() -> float:
         _diag(config=1, device_trace_fallback=str(e)[:200])
 
     per_step, compile_s, resolution, final = _time_scan_step(
-        step, mc.init_state(), k1=500, k2=4000
+        step, state0, k1=500, k2=4000
     )
-    vals = mc.pure_compute(final)
+    vals = mc.pure_compute(final[1])
     assert np.isfinite(float(np.asarray(vals["acc"]))), "bench produced non-finite metric"
     _diag(config=1, compile_s=round(compile_s, 1), resolution_us=round(resolution * 1e6, 2))
     return max(per_step, resolution)
@@ -455,8 +470,18 @@ def bench_config2() -> None:
     target = jnp.asarray(rng.randint(0, 2, (batch,)))
     mc.update(preds, target)  # warm eager mode detection
 
-    state0 = mc.pure_update(mc.init_state(), preds, target)  # 1 row block in
-    step = lambda s: mc.pure_update(s, preds, target)  # noqa: E731
+    import jax
+
+    # 1 row block in; chk-carry keeps the confmat bincount inside the loop
+    # (same LICM guard as configs 1/7 — the CatBuffer append is offset-
+    # dependent and safe, but invariant preds would let XLA hoist the rest)
+    state0 = (jnp.zeros(()), mc.pure_update(mc.init_state(), preds, target))
+
+    def step(state):
+        chk, s = state
+        new = mc.pure_update(s, preds + chk * 1e-24, target)
+        return (chk + jnp.sum(new["confmat"]["confmat"].astype(jnp.float32)) * 1e-24, new)
+
     per_step = resolution = None
     try:
         # device-timeline measurement: the K-step scan's device duration has
@@ -481,6 +506,7 @@ def bench_config2() -> None:
         upper_bound = per_step < resolution
         _diag(config=2, compile_s=round(compile_s, 1), upper_bound=upper_bound,
               resolution_us=round(resolution * 1e6, 2))
+    final = final[1]  # drop the chk carry
     n_rows = int(np.asarray(final["auroc"]["preds"].count))
     assert n_rows == batch * steps_cap, f"CatBuffer row count {n_rows} != capacity {batch * steps_cap}"
     val = mc.pure_compute(final)
@@ -646,13 +672,17 @@ def bench_config3() -> None:
             ext = InceptionFeatureExtractor(feature=2048, dtype=dtype)
             x = jnp.asarray(rng.rand(b, 3, 299, 299).astype(np.float32))
 
-            def fwd_step(chk, _ext=ext, _x=x):
-                f = _ext(_x + chk * 1e-24)
-                return chk + f.astype(jnp.float32).sum() * 1e-12
+            # imgs ride the scan CARRY, not a closure: a closed-over batch is
+            # baked into the program as a constant, and at batch 256 the 274MB
+            # payload overflows the remote-compile request (HTTP 413)
+            def fwd_step(state, _ext=ext):
+                chk, imgs_c = state
+                f = _ext(imgs_c + chk * 1e-24)
+                return (chk + f.astype(jnp.float32).sum() * 1e-12, imgs_c)
 
             name = f"cfg3_fwd_{tag}"
             med, alls, progs, c_s = _device_step_us(
-                {name: fwd_step}, jnp.zeros(()), k=8, execs=6
+                {name: fwd_step}, (jnp.zeros(()), x), k=8, execs=6
             )
             # FLOPs from a single-forward program: cost_analysis of a scanned
             # while-loop may count the body once, so don't divide the scan's
@@ -737,17 +767,26 @@ def bench_config4() -> None:
                     params,
                 )
 
-            def enc_step(chk, _p=params, _c=cfg):
-                hidden = bert_apply(_p, ids, mask, config=_c)
-                return chk + hidden[-1].astype(jnp.float32).sum() * 1e-12
+            # token ids must depend on the loop carry — an invariant encoder
+            # body gets hoisted out of the scan by XLA and the per-step time
+            # collapses to ~0 (caught in the first r5 capture: 0.0 ms/fwd).
+            # params ride the carry, not a closure: closed-over weights are
+            # baked into the program (220MB for base-bf16) and overflow the
+            # remote-compile request limit (HTTP 413)
+            def enc_step(state, _c=cfg):
+                i, acc, p = state
+                ids_i = (ids + i) % 30000
+                hidden = bert_apply(p, ids_i, mask, config=_c)
+                return (i + 1, acc + hidden[-1].astype(jnp.float32).sum() * 1e-12, p)
 
             name = f"cfg4_enc_{tag}"
             med, alls, progs, c_s = _device_step_us(
-                {name: enc_step}, jnp.zeros(()), k=8, execs=6
+                {name: enc_step}, (jnp.zeros((), jnp.int32), jnp.zeros(()), params),
+                k=8, execs=6,
             )
             flops = _program_flops(
-                jax.jit(lambda i, m, _p=params, _c=cfg: bert_apply(_p, i, m, config=_c)[-1]),
-                ids, mask,
+                jax.jit(lambda p, i, m, _c=cfg: bert_apply(p, i, m, config=_c)[-1]),
+                params, ids, mask,
             )
             step_us = float(med[name])
             achieved = flops / (step_us * 1e-6) if flops else None

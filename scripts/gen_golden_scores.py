@@ -1,0 +1,83 @@
+"""Generate the pinned scalars for tests/test_golden_pipeline_scores.py.
+
+Runs on the same platform as the test suite (CPU, pinned before backend
+init) so the printed values are exactly what CI will assert. Re-run after
+any INTENTIONAL numerical change to the towers/pipelines and update the
+pins (the test docstring says the same).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import json  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def golden_inputs():
+    rng = np.random.RandomState(1234)
+    real = jnp.asarray(rng.randint(0, 256, (24, 3, 64, 64), dtype=np.uint8))
+    fake = jnp.asarray(rng.randint(0, 256, (24, 3, 64, 64), dtype=np.uint8))
+    return real, fake
+
+
+def main() -> None:
+    from metrics_tpu import BERTScore, FID, IS, KID, LPIPS
+    from metrics_tpu.models.inception import InceptionFeatureExtractor
+
+    out = {}
+    real, fake = golden_inputs()
+
+    ext = InceptionFeatureExtractor(feature=64)  # deterministic init (key 0)
+    real_f, fake_f = ext(real), ext(fake)
+
+    fid = FID(feature=lambda f: f, feature_dim=64, streaming=True)
+    fid.update(real_f, True)
+    fid.update(fake_f, False)
+    out["fid_64tap_streaming"] = float(fid.compute())
+
+    fid_cat = FID(feature=lambda f: f, feature_dim=64)
+    fid_cat.update(real_f, True)
+    fid_cat.update(fake_f, False)
+    out["fid_64tap_cat"] = float(fid_cat.compute())
+
+    kid = KID(feature=lambda f: f, subsets=4, subset_size=16)
+    kid.update(real_f, True)
+    kid.update(fake_f, False)
+    kmean, kstd = kid.compute()
+    out["kid_64tap_mean"] = float(kmean)
+    out["kid_64tap_std"] = float(kstd)
+
+    lp = LPIPS(net_type="alex")
+    a = jnp.asarray(np.random.RandomState(5).rand(4, 3, 64, 64).astype(np.float32) * 2 - 1)
+    b = jnp.asarray(np.random.RandomState(6).rand(4, 3, 64, 64).astype(np.float32) * 2 - 1)
+    lp.update(a, b)
+    out["lpips_alex"] = float(lp.compute())
+
+    bs = BERTScore(max_length=32)
+    bs.update(
+        ["the quick brown fox jumps over the lazy dog", "hello world"],
+        ["a quick brown fox jumped over lazy dogs", "hello there world"],
+    )
+    res = bs.compute()
+    out["bertscore_f1_mean"] = float(np.mean(res["f1"]))
+    out["bertscore_p_mean"] = float(np.mean(res["precision"]))
+
+    # full-graph IS (nightly pin: 1008-logit tower end to end)
+    isc = IS(splits=2)
+    isc.update(jnp.concatenate([real[:8], fake[:8]], axis=0))
+    imean, istd = isc.compute()
+    out["is_full_graph_mean"] = float(imean)
+    out["is_full_graph_std"] = float(istd)
+
+    print(json.dumps(out, indent=2))
+
+
+if __name__ == "__main__":
+    main()
