@@ -176,6 +176,18 @@ class CatBuffer:
         out_dtype = value.dtype if jnp.issubdtype(value.dtype, jnp.floating) else jnp.float32
         return jnp.where(self.overflowed, jnp.asarray(jnp.nan, out_dtype), value.astype(out_dtype))
 
+    def has_nonfinite(self) -> Array:
+        """Scalar bool: any NaN/Inf among the accumulated rows — jit-safe.
+
+        The ``check_finite`` screening hook (``Metric.enable_check_finite``):
+        padding rows are zero by construction (append writes into a zeroed
+        buffer; merge/sync re-zero their tails), so the whole-buffer check is
+        exact without a mask reduction. Integer buffers are always finite.
+        """
+        if self.buffer is None or not jnp.issubdtype(self.buffer.dtype, jnp.inexact):
+            return jnp.zeros((), jnp.bool_)
+        return jnp.logical_not(jnp.all(jnp.isfinite(self.buffer)))
+
     def __len__(self) -> int:
         return int(self.count)
 

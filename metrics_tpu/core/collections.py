@@ -5,8 +5,9 @@ TPU upgrade: :meth:`pure_forward` traces *all* member metrics' update + sync +
 compute into a single XLA program, so a collection costs one fused reduction
 over the mesh instead of one gather per metric (the BASELINE north star).
 """
+from contextlib import contextmanager
 from copy import deepcopy
-from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
 from metrics_tpu.core.metric import Metric
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
@@ -165,6 +166,77 @@ class MetricCollection(dict):
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
         for k, m in super().items():
             m.load_state_dict(state_dict, prefix=f"{k}.")
+
+    # ---------------- host sync (fault-tolerance aware) ----------------
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Callable] = None,
+        on_error: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> None:
+        """Host-sync every member, threading the fault-tolerance knobs.
+
+        All-or-nothing under ``on_error="raise"``: if a member's sync raises
+        a typed ``SyncError`` mid-way, the members already synced are rolled
+        back to their local state before the error propagates, so the
+        collection is never left half-synced. Under ``"local"``/``"warn"``
+        each member degrades independently (``Metric.sync`` swallows the
+        error per member) and healthy members still report global values.
+        """
+        synced: List[Metric] = []
+        try:
+            for m in self.values():
+                m.sync(
+                    dist_sync_fn=dist_sync_fn,
+                    should_sync=should_sync,
+                    distributed_available=distributed_available,
+                    on_error=on_error,
+                    timeout=timeout,
+                )
+                if m._is_synced:
+                    synced.append(m)
+        except Exception:
+            for m in synced:
+                m.unsync()
+            raise
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        """Restore every synced member's pre-sync local state.
+
+        Members that degraded to local-only state (``on_error="local"``)
+        were never marked synced and are skipped rather than raising."""
+        if not should_unsync:
+            return
+        for m in self.values():
+            if m._is_synced:
+                m.unsync()
+
+    @contextmanager
+    def sync_context(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        should_sync: bool = True,
+        should_unsync: bool = True,
+        distributed_available: Optional[Callable] = None,
+        on_error: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> Iterator["MetricCollection"]:
+        """Collection-wide sync-on-enter / restore-on-exit (the consistent-
+        checkpoint pattern), with ``on_error`` graceful degradation."""
+        self.sync(
+            dist_sync_fn=dist_sync_fn,
+            should_sync=should_sync,
+            distributed_available=distributed_available,
+            on_error=on_error,
+            timeout=timeout,
+        )
+        try:
+            yield self
+        finally:
+            self.unsync(should_unsync=should_unsync)
 
     # ---------------- pure-functional fused path ----------------
 

@@ -24,6 +24,7 @@ re-designed around JAX's functional model:
   reference ``metric.py:184-188,271-272,299-303``.
 """
 import functools
+import warnings
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
@@ -33,14 +34,22 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.cat_buffer import CatBuffer
+from metrics_tpu.parallel.health import NONFINITE_STATE
 from metrics_tpu.parallel.sync import (
     host_sync_state,
     jit_distributed_available,
     sync_in_jit,
 )
 from metrics_tpu.utils.data import apply_to_collection, is_traced
-from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.utils.exceptions import (
+    MetricsTPUUserError,
+    NonFiniteStateError,
+    SyncError,
+)
 from metrics_tpu.utils.prints import rank_zero_warn
+
+#: Accepted ``on_error`` / ``sync_on_error`` degradation modes.
+_ON_ERROR_MODES = ("raise", "local", "warn")
 
 _MERGEABLE_FX = ("sum", "cat", "max", "min")
 
@@ -87,6 +96,50 @@ def _cast_floating(tree: Any, dtype: Any) -> Any:
         return x
 
     return apply_to_collection(tree, (jnp.ndarray, np.ndarray), cast)
+
+
+def _leaf_nonfinite(x: Any) -> Optional[Array]:
+    if not isinstance(x, (jnp.ndarray, np.ndarray)):
+        return None
+    x = jnp.asarray(x)
+    if not jnp.issubdtype(x.dtype, jnp.inexact):
+        return None
+    return jnp.logical_not(jnp.all(jnp.isfinite(x)))
+
+
+def _update_nonfinite_flag(
+    state: Dict[str, Any], inputs: Any, prev_list_lens: Dict[str, int]
+) -> Array:
+    """int32 0/1: NaN/Inf introduced by this ``update`` — jit-safe, O(batch).
+
+    Screens the update's float *inputs*, the non-cat state leaves, and only
+    the list entries appended during this update (``prev_list_lens`` holds
+    each list state's pre-update length). CatBuffer bodies are deliberately
+    NOT rescanned per step — their rows are the screened inputs, and a full
+    buffer scan would cost O(capacity) every update; the exact whole-state
+    scan runs once at the sync/compute boundary instead
+    (:func:`~metrics_tpu.parallel.health.state_has_nonfinite`). The
+    reserved poison flag itself is excluded (destination, not source)."""
+    import jax
+
+    bad = jnp.zeros((), jnp.bool_)
+    for leaf in jax.tree_util.tree_leaves(inputs):
+        b = _leaf_nonfinite(leaf)
+        if b is not None:
+            bad = jnp.logical_or(bad, b)
+    for name, v in state.items():
+        if name == NONFINITE_STATE or isinstance(v, CatBuffer):
+            continue
+        if isinstance(v, (list, tuple)):
+            for x in v[prev_list_lens.get(name, 0):]:
+                b = _leaf_nonfinite(x)
+                if b is not None:
+                    bad = jnp.logical_or(bad, b)
+        else:
+            b = _leaf_nonfinite(v)
+            if b is not None:
+                bad = jnp.logical_or(bad, b)
+    return bad.astype(jnp.int32)
 
 
 def _copy_state_value(v: Any) -> Any:
@@ -164,6 +217,22 @@ class Metric:
         dist_sync_fn: custom callable ``(state_dict, reductions) -> state_dict``
             replacing the built-in host sync — the seam integrations use
             (reference ``metric.py:78``).
+        check_finite: screen every ``update``/``forward`` for NaN/Inf (the
+            update's float inputs plus newly-written state leaves, O(batch);
+            an exact whole-state scan backstops at the sync/compute
+            boundary), latching a hidden int32 poison-flag state
+            (``dist_reduce_fx="sum"``, so it propagates in-jit as one psum
+            and on the host via the sync header). A poisoned sync raises
+            :class:`~metrics_tpu.utils.exceptions.NonFiniteStateError` on
+            every rank together (see ``docs/fault_tolerance.md``).
+        sync_on_error: default degradation mode for host sync failures —
+            ``"raise"`` propagates the typed ``SyncError``; ``"local"``
+            falls back to this process's local-only state with a
+            rank-zero warning; ``"warn"`` does the same but warns on every
+            rank. Overridable per call via ``sync(on_error=...)``.
+        sync_timeout: watchdog timeout (seconds) for this metric's host
+            collectives; ``None`` uses the ``METRICS_TPU_SYNC_TIMEOUT_S``
+            env knob (default 600), ``0`` disables the watchdog.
     """
 
     #: Whether the metric value is differentiable w.r.t. its float inputs.
@@ -171,12 +240,20 @@ class Metric:
     #: True/False matching the reference's per-class declarations.
     is_differentiable: Optional[bool] = None
 
+    #: Make update-count skew fatal at sync (StateDivergenceError on every
+    #: rank) instead of a rank-zero warning. Plain attribute so it can be
+    #: flipped on any constructed metric.
+    sync_strict_update_count: bool = False
+
     def __init__(
         self,
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
         dist_sync_fn: Optional[Callable] = None,
+        check_finite: bool = False,
+        sync_on_error: str = "raise",
+        sync_timeout: Optional[float] = None,
     ) -> None:
         # bypass custom __setattr__ while bootstrapping
         object.__setattr__(self, "_state", {})
@@ -187,17 +264,28 @@ class Metric:
         self.dist_sync_on_step = dist_sync_on_step
         self.process_group = process_group
         self.dist_sync_fn = dist_sync_fn
+        if sync_on_error not in _ON_ERROR_MODES:
+            raise MetricsTPUUserError(
+                f"`sync_on_error` must be one of {_ON_ERROR_MODES}, got {sync_on_error!r}"
+            )
+        self.sync_on_error = sync_on_error
+        self.sync_timeout = sync_timeout
         # overridable seam for integrations/tests: sync() fires only when this
         # reports a world (reference gates on torch.distributed initialization,
         # metric.py:274-277; here the default is multi-process JAX)
         self.distributed_available_fn: Callable[[], bool] = jit_distributed_available
         self._update_called = False
+        self._update_count = 0
         self._computed: Any = None
         self._forward_cache: Any = None
         self._to_sync = True
         self._is_synced = False
+        self._sync_degraded = False
         self._cache: Optional[Dict[str, Any]] = None
         self._dtype: Any = None
+        self.check_finite = False
+        if check_finite:
+            self.enable_check_finite()
 
     # ------------------------------------------------------------------
     # state declaration & attribute routing
@@ -266,6 +354,37 @@ class Metric:
                     )
                 self._defaults[name] = CatBuffer(capacity)
                 self._state[name] = CatBuffer(capacity)
+        return self
+
+    def enable_check_finite(self) -> "Metric":
+        """Turn on NaN/Inf screening for this metric. Returns ``self``.
+
+        Registers the hidden ``_nonfinite`` poison-flag state (an int32
+        scalar with ``dist_reduce_fx="sum"``) and screens every subsequent
+        ``update``/``forward`` at O(batch) cost: the update's float inputs,
+        the non-cat state leaves, and the list entries appended by that
+        update latch the flag (CatBuffer bodies are not rescanned per step;
+        the exact whole-state scan runs once at the sync/compute boundary).
+        The flag propagates through both sync paths — in-jit as part of the
+        ordinary ``psum`` round, on the host via the sync header — so a
+        poisoned rank fails **symmetrically** with
+        :class:`~metrics_tpu.utils.exceptions.NonFiniteStateError` instead
+        of quietly corrupting the global aggregate. Library metrics (whose
+        constructors predate the knob) opt in post-construction::
+
+            metric = Accuracy(num_classes=10).enable_check_finite()
+
+        Must be called before the first ``update`` (the flag must cover the
+        whole accumulation to mean anything).
+        """
+        if NONFINITE_STATE not in self._defaults:
+            if self._update_called:
+                raise MetricsTPUUserError(
+                    "enable_check_finite() must be called before the first "
+                    "update() — the poison flag must cover the whole accumulation."
+                )
+            self.add_state(NONFINITE_STATE, jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        self.check_finite = True
         return self
 
     def __getattr__(self, name: str) -> Any:
@@ -361,24 +480,68 @@ class Metric:
     # sync machinery
     # ------------------------------------------------------------------
 
-    def _run_dist_sync(self, state: Dict[str, Any]) -> Dict[str, Any]:
-        fn = self.dist_sync_fn
+    def _local_state_poisoned(self) -> bool:
+        """Eager check: is THIS rank's own state NaN/Inf-poisoned?"""
+        from metrics_tpu.parallel.health import state_poisoned
+
+        flag = self._state.get(NONFINITE_STATE)
+        if flag is None or is_traced(flag):
+            return False
+        return state_poisoned(self._state)
+
+    def _run_dist_sync(
+        self,
+        state: Dict[str, Any],
+        timeout: Optional[float] = None,
+        fn: Optional[Callable] = None,
+    ) -> Dict[str, Any]:
+        """Run the sync transport: injected ``fn`` (or ``self.dist_sync_fn``)
+        if set, else the built-in health-checked host sync. The single place
+        the fault-tolerance knobs thread into :func:`host_sync_state`."""
+        fn = self.dist_sync_fn if fn is None else fn
         if fn is not None:
             return fn(state, self._reductions)
-        return host_sync_state(state, self._reductions)
+        return host_sync_state(
+            state,
+            self._reductions,
+            update_count=getattr(self, "_update_count", 0),
+            strict_update_count=self.sync_strict_update_count,
+            timeout=timeout if timeout is not None else getattr(self, "sync_timeout", None),
+            metric_name=type(self).__name__,
+        )
 
     def sync(
         self,
         dist_sync_fn: Optional[Callable] = None,
         should_sync: bool = True,
         distributed_available: Optional[Callable] = None,
+        on_error: Optional[str] = None,
+        timeout: Optional[float] = None,
     ) -> None:
         """Synchronize state across processes (host path); caches local state.
 
-        Analogue of reference ``metric.py:253-287``.
+        Analogue of reference ``metric.py:253-287``, hardened: the built-in
+        path runs the sync-header health protocol (one collective verifying
+        empty/overflow/schema/non-finite/update-count divergence on every
+        rank together) plus the watchdog timeout, and ``on_error`` selects
+        what a typed ``SyncError`` does:
+
+        - ``"raise"`` (default): propagate — the job fails loudly;
+        - ``"local"``: keep this process's local-only state, emit a
+          rank-zero warning, and continue un-synced (graceful degradation:
+          ``compute()`` then reports local data only);
+        - ``"warn"``: like ``"local"`` but warns on every rank.
+
+        ``on_error``/``timeout`` default to the constructor's
+        ``sync_on_error``/``sync_timeout``.
         """
         if self._is_synced and should_sync:
             raise MetricsTPUUserError("The Metric has already been synced.")
+        on_error = getattr(self, "sync_on_error", "raise") if on_error is None else on_error
+        if on_error not in _ON_ERROR_MODES:
+            raise MetricsTPUUserError(
+                f"`on_error` must be one of {_ON_ERROR_MODES}, got {on_error!r}"
+            )
         is_distributed = (
             distributed_available() if distributed_available is not None else self.distributed_available_fn()
         )
@@ -394,10 +557,41 @@ class Metric:
                 "all processes. Drop `process_group` or inject `dist_sync_fn`."
             )
         self._cache = {k: _copy_state_value(v) for k, v in self._state.items()}
-        if fn is not None:
-            synced = fn(self._cache, self._reductions)
-        else:
-            synced = host_sync_state(self._cache, self._reductions)
+        self._sync_degraded = False
+        try:
+            synced = self._run_dist_sync(self._cache, timeout=timeout, fn=fn)
+        except SyncError as err:
+            self._cache = None
+            if on_error == "raise":
+                raise
+            # swallowed: mark the degradation so a paired unsync() is a
+            # tolerated no-op instead of an "already un-synced" crash
+            self._sync_degraded = True
+            if isinstance(err, NonFiniteStateError) and self._local_state_poisoned():
+                # degradation promises a degraded-but-CORRECT local result;
+                # when this rank's own state is the poisoned one, its local
+                # values are garbage — say so instead of implying they are
+                # merely partial (every rank warns: rank-zero gating could
+                # hide the corruption on a non-zero rank)
+                warnings.warn(
+                    f"Cross-process sync of {type(self).__name__} failed "
+                    f"({type(err).__name__}: {err}) — falling back to LOCAL-ONLY "
+                    "state, and THIS process's own state is NaN/Inf-poisoned: "
+                    "reported values are CORRUPT, not merely partial.",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+                return
+            msg = (
+                f"Cross-process sync of {type(self).__name__} failed "
+                f"({type(err).__name__}: {err}) — falling back to LOCAL-ONLY "
+                "state; reported values cover this process's data only."
+            )
+            if on_error == "warn":
+                warnings.warn(msg, RuntimeWarning, stacklevel=2)
+            else:
+                rank_zero_warn(msg, RuntimeWarning)
+            return
         self._restore(synced)
         self._is_synced = True
 
@@ -406,6 +600,13 @@ class Metric:
         if not should_unsync:
             return
         if not self._is_synced:
+            if self._sync_degraded:
+                # the paired sync degraded under on_error="local"/"warn" and
+                # kept the local state — the documented sync → state_dict →
+                # unsync pattern must not crash the very job degradation
+                # just saved; accept the unsync as a no-op
+                self._sync_degraded = False
+                return
             raise MetricsTPUUserError("The Metric has already been un-synced.")
         if self._cache is None:
             raise MetricsTPUUserError("The internal cache should exist to unsync the Metric.")
@@ -432,11 +633,16 @@ class Metric:
         should_sync: bool = True,
         should_unsync: bool = True,
         distributed_available: Optional[Callable] = None,
+        on_error: Optional[str] = None,
+        timeout: Optional[float] = None,
     ) -> "Metric._SyncContext":
         """Context manager: sync on enter, restore local state on exit.
 
         Analogue of reference ``metric.py:311-343``; the documented pattern for
-        consistent checkpoints (sync → state_dict → unsync).
+        consistent checkpoints (sync → state_dict → unsync). ``on_error`` /
+        ``timeout`` thread to :meth:`sync`; with ``on_error="local"`` a
+        failed sync leaves the metric un-synced on its local state (the
+        context body still runs, and exit skips the unsync).
         """
         return Metric._SyncContext(
             self,
@@ -444,6 +650,8 @@ class Metric:
             should_sync=should_sync,
             should_unsync=should_unsync,
             distributed_available=distributed_available,
+            on_error=on_error,
+            timeout=timeout,
         )
 
     # ------------------------------------------------------------------
@@ -458,12 +666,17 @@ class Metric:
         """Pure functional update: ``state -> new state``. jit-compatible for
         fixed-shape (non-list) states."""
         saved = self._state
+        saved_count = getattr(self, "_update_count", 0)
         self._state = {k: _copy_state_value(v) for k, v in state.items()}
         try:
             self.update(*args, **kwargs)
             return self._state
         finally:
             self._state = saved
+            # the counter rides the health word for the STATEFUL accumulation;
+            # a pure update operates on an explicit state pytree (warm-ups,
+            # scan carries) and must not skew it across ranks
+            self._update_count = saved_count
 
     def pure_compute(self, state: Dict[str, Any]) -> Any:
         """Pure functional compute over an explicit state pytree."""
@@ -597,6 +810,7 @@ class Metric:
     def reset(self) -> None:
         """Reset state to defaults (reference ``metric.py:381-398``)."""
         self._update_called = False
+        self._update_count = 0
         self._forward_cache = None
         self._computed = None
         self._restore(self._default_state())
@@ -935,6 +1149,26 @@ def _wrap_update(update: Callable) -> Callable:
             )
         self._computed = None
         self._update_called = True
+        from metrics_tpu.utils.checks import _tracing_active
+
+        if not _tracing_active() and not any(
+            is_traced(leaf) for leaf in jax.tree_util.tree_leaves((args, kwargs))
+        ):
+            # per-update counter: rides the health word so update-count skew
+            # across ranks is detectable before a payload gather. Trace-time
+            # invocations (pure_update/pure_forward under jit) don't count:
+            # retraces are a compilation artifact, not data, and counting
+            # them would skew the header across ranks that retrace unevenly
+            self._update_count = getattr(self, "_update_count", 0) + 1
+        screening = getattr(self, "check_finite", False) and NONFINITE_STATE in self._state
+        if screening:
+            # pre-update list lengths: the post-update screen covers only the
+            # entries THIS update appended (O(batch), not O(accumulated))
+            prev_list_lens = {
+                name: len(v)
+                for name, v in self._state.items()
+                if isinstance(v, (list, tuple))
+            }
         out = update(self, *args, **kwargs)
         if self._dtype is not None:
             # set_dtype persistence: functional `state + batch_stat` promotes
@@ -962,6 +1196,12 @@ def _wrap_update(update: Callable) -> Callable:
                         count=np.zeros((), np.int32),
                         overflowed=np.zeros((), np.bool_),
                     )
+        if screening:
+            # latch (never clear) the poison flag: jnp.maximum keeps the
+            # screen jit-safe, and fx="sum" carries it through psum/merge
+            flag = _update_nonfinite_flag(self._state, (args, kwargs), prev_list_lens)
+            prev = jnp.asarray(self._state[NONFINITE_STATE], jnp.int32)
+            self._state[NONFINITE_STATE] = jnp.maximum(prev, flag)
         return out
 
     wrapped_func._wrapped = True  # type: ignore[attr-defined]
@@ -987,6 +1227,23 @@ def _wrap_compute(compute: Callable) -> Callable:
         )
         should = self._to_sync and self._is_synced is False and not is_tracing
         if (
+            getattr(self, "check_finite", False)
+            and not is_tracing
+            and not self.distributed_available_fn()
+        ):
+            # single-process enforcement of the poison flag (multi-process
+            # runs raise symmetrically via the sync header instead — raising
+            # here before the gather would strand the healthy ranks)
+            from metrics_tpu.parallel.health import state_poisoned
+
+            flag = self._state.get(NONFINITE_STATE)
+            if flag is not None and not is_traced(flag) and state_poisoned(self._state):
+                raise NonFiniteStateError(
+                    f"{type(self).__name__} accumulated non-finite (NaN/Inf) state "
+                    "values (check_finite screening); compute() refused rather than "
+                    "returning a silently-corrupt result."
+                )
+        if (
             should
             and self.process_group is not None
             and self.dist_sync_fn is None
@@ -1007,6 +1264,19 @@ def _wrap_compute(compute: Callable) -> Callable:
             should_sync=should,
             should_unsync=should,
         ):
+            if getattr(self, "check_finite", False) and not is_tracing and self._is_synced:
+                # post-sync enforcement: with a custom `dist_sync_fn` the
+                # health header never runs, but the poison flag still rides
+                # the transport (fx="sum"), so every rank sees the same
+                # world-summed value here and raises together. Redundant
+                # (and cheap) on the built-in path, which raised pre-gather.
+                flag = self._state.get(NONFINITE_STATE)
+                if flag is not None and not is_traced(flag) and int(np.asarray(flag)) > 0:
+                    raise NonFiniteStateError(
+                        f"{type(self).__name__}: a participating process accumulated "
+                        "non-finite (NaN/Inf) state values (check_finite screening; "
+                        "poison flag gathered through the sync transport)."
+                    )
             self._computed = compute(self, *args, **kwargs)
         return self._computed
 

@@ -1,6 +1,15 @@
+from metrics_tpu.parallel.health import (
+    NONFINITE_STATE,
+    build_health_word,
+    call_with_sync_watchdog,
+    distributed_initialize_with_retry,
+    get_sync_timeout,
+    verify_health_words,
+)
 from metrics_tpu.parallel.sync import (
     class_reduce,
     gather_all_arrays,
+    host_sync_leaf,
     host_sync_state,
     jit_distributed_available,
     reduce,

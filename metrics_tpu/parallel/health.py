@@ -1,0 +1,526 @@
+"""Fault-tolerant sync-header protocol for host-path metric synchronization.
+
+Collective-communication protocols live or die on every rank taking the
+identical branch (EQuARX, arxiv 2506.17615; portable collective
+redistribution, arxiv 2112.01075). The host sync path historically enforced
+that ad hoc for two divergence classes (empty CatBuffer, overflow) with one
+extra ``process_allgather`` per class per state leaf — and hung, or raised
+one-sided, for every other class.
+
+This module replaces those ad-hoc gathers with a **sync header**: before any
+payload gather, every rank contributes one small int32 *health word* per
+metric in a *single* ``process_allgather``::
+
+    [version, schema_hash, update_count, overflow, nonfinite, n_states,
+     count_0 ... count_{COUNT_SLOTS-1}]
+
+- ``version``       protocol version (software-skew detection across ranks);
+- ``schema_hash``   CRC32 over the state schema (names, kinds, dtypes, item
+                    shapes, reductions) — leading "data" dims excluded, so
+                    uneven batches hash equal but a mis-configured metric
+                    (e.g. differing ``num_classes``) does not;
+- ``update_count``  number of ``update()`` calls folded into the state;
+- ``overflow``      OR of all CatBuffer states' sticky overflow flags;
+- ``nonfinite``     the ``check_finite`` poison verdict: the latched flag OR
+                    an exact state scan (0 when screening is off);
+- ``n_states``      number of declared states (poison flag included);
+- ``count_j``       participation count of the j-th state (sorted by name):
+                    CatBuffer fill count, number of appended batches for
+                    list states (a rank that appended one zero-row batch
+                    still participates — matching the pre-header per-leaf
+                    protocol), else array size. Unused slots hold ``-1``;
+                    metrics with more than ``COUNT_SLOTS`` states fold the
+                    tail's cat-family minimum into the last slot.
+
+The word has the SAME fixed width for *every* metric — not merely for every
+rank running the same metric — so the header gather itself is a well-formed
+collective even when ranks disagree about which metric (or how many states)
+they are syncing; that divergence is then caught *symmetrically* by the
+``n_states``/``schema_hash`` columns instead of crashing or hanging
+one-sidedly inside the gather.
+
+Every rank then verifies the *gathered* ``[world, width]`` matrix with
+:func:`verify_health_words`. Because the input is identical on every rank
+and verification is deterministic, all ranks raise the **same typed
+exception** (``StateDivergenceError`` / ``NonFiniteStateError`` /
+``SyncError``) together — zero one-sided hangs — or all proceed to the
+payload gathers knowing no rank can fault mid-collective for a detectable
+reason.
+
+The module also provides the two liveness guards:
+
+- :func:`call_with_sync_watchdog` — a thread-timer watchdog around host
+  collectives that raises :class:`~metrics_tpu.utils.exceptions.SyncTimeoutError`
+  instead of blocking forever on a dead/stalled peer (knob:
+  ``METRICS_TPU_SYNC_TIMEOUT_S``, default 600; ``0`` disables);
+- :func:`distributed_initialize_with_retry` — retry-with-backoff around
+  ``jax.distributed.initialize`` coordinator binding, absorbing the
+  free-port race between probing a port and the coordinator binding it.
+"""
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional, TypeVar
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utils.exceptions import (
+    NonFiniteStateError,
+    StateDivergenceError,
+    SyncError,
+    SyncTimeoutError,
+)
+from metrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = [
+    "HEALTH_PROTOCOL_VERSION",
+    "COUNT_SLOTS",
+    "WORD_WIDTH",
+    "NONFINITE_STATE",
+    "build_health_word",
+    "state_has_nonfinite",
+    "state_poisoned",
+    "verify_health_words",
+    "call_with_sync_watchdog",
+    "get_sync_timeout",
+    "distributed_initialize_with_retry",
+    "channel_is_suspect",
+    "reset_channel_health",
+]
+
+T = TypeVar("T")
+
+HEALTH_PROTOCOL_VERSION = 1
+
+#: Reserved state name for the ``check_finite`` poison flag (see
+#: ``Metric.enable_check_finite``): an int32 scalar with ``dist_reduce_fx="sum"``
+#: so it propagates in-jit as one ``psum`` and on the host via the health word.
+NONFINITE_STATE = "_nonfinite"
+
+# health-word column layout (per-state participation counts follow the
+# fixed part; total width is constant across ALL metrics so the header
+# gather is well-formed under any cross-rank divergence)
+_F_VERSION = 0
+_F_SCHEMA = 1
+_F_UPDATES = 2
+_F_OVERFLOW = 3
+_F_NONFINITE = 4
+_F_NSTATES = 5
+_F_FIXED = 6
+
+#: Fixed number of per-state count slots; unused slots hold the -1 sentinel.
+COUNT_SLOTS = 16
+WORD_WIDTH = _F_FIXED + COUNT_SLOTS
+
+#: Watchdog default (seconds); env knob ``METRICS_TPU_SYNC_TIMEOUT_S``, 0 = off.
+DEFAULT_SYNC_TIMEOUT_S = 600.0
+
+
+def get_sync_timeout(override: Optional[float] = None) -> float:
+    """Effective watchdog timeout: explicit override > env knob > default."""
+    if override is not None:
+        return float(override)
+    return float(os.environ.get("METRICS_TPU_SYNC_TIMEOUT_S", DEFAULT_SYNC_TIMEOUT_S))
+
+
+def _state_kinds(state: Dict[str, Any]):
+    """(sorted names, kind per name) — the shared vocabulary of word build
+    and verification. Kinds: 'catbuf' | 'list' | 'leaf'."""
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
+    names = sorted(state)
+    kinds = {}
+    for name in names:
+        v = state[name]
+        if isinstance(v, CatBuffer):
+            kinds[name] = "catbuf"
+        elif isinstance(v, (list, tuple)):
+            kinds[name] = "list"
+        else:
+            kinds[name] = "leaf"
+    return names, kinds
+
+
+def state_schema_hash(state: Dict[str, Any], reductions: Dict[str, Any]) -> int:
+    """Stable 31-bit CRC over the metric's state *schema*.
+
+    Covers state names, kinds, dtypes, item shapes and declared reductions —
+    everything that must agree across ranks for the payload gathers to be
+    well-formed. Leading ("data") dims of cat-family states are excluded so
+    legitimately uneven per-rank batches hash equal; an empty list state
+    contributes only its name/kind (its dtype/item shape are unknown until
+    the first append, and emptiness is caught by the count columns *before*
+    the schema check so the hash never misattributes it).
+    """
+    import zlib
+
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
+    parts = []
+    for name in sorted(state):
+        v = state[name]
+        fx = reductions.get(name)
+        fx_tag = fx if isinstance(fx, str) or fx is None else "callable"
+        if isinstance(v, CatBuffer):
+            item = "?" if v.buffer is None else f"{v.buffer.dtype}{tuple(v.buffer.shape[1:])}"
+            parts.append(f"{name}|catbuf|{item}|{fx_tag}")
+        elif isinstance(v, (list, tuple)):
+            if len(v):
+                first = jnp.asarray(v[0])
+                item = f"{first.dtype}{tuple(first.shape[1:])}"
+            else:
+                item = "?"
+            parts.append(f"{name}|list|{item}|{fx_tag}")
+        else:
+            arr = jnp.asarray(v)
+            shape = tuple(arr.shape[1:]) if fx in ("cat", None) else tuple(arr.shape)
+            parts.append(f"{name}|leaf|{arr.dtype}{shape}|{fx_tag}")
+    return zlib.crc32(";".join(parts).encode()) & 0x7FFFFFFF
+
+
+def _element_count(value: Any, kind: str) -> int:
+    """Participation count: can this rank contribute this state's payload?
+
+    CatBuffer: fill count (rows). List: number of appended batches — a rank
+    whose only batch was ragged-empty (zero rows) still participates, just
+    as the pre-header per-leaf ``len(vals)`` gather allowed (the pad/trim
+    gather handles zero-row leading dims). Leaf: array size.
+    """
+    if kind == "catbuf":
+        return int(np.asarray(value.count))
+    if kind == "list":
+        return len(value)
+    return int(np.asarray(jnp.size(value)))
+
+
+def state_has_nonfinite(state: Dict[str, Any]) -> bool:
+    """Exact eager scan: any NaN/Inf among the float leaves of ``state``.
+
+    The sync/compute-boundary complement of the cheap per-update input
+    screening (``Metric.enable_check_finite``): CatBuffer rows are only
+    re-scanned here, once per sync, instead of O(capacity) per update.
+    The reserved poison flag itself is excluded. Host-path only."""
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
+    def _bad(x: Any) -> bool:
+        x = np.asarray(x)
+        return bool(np.issubdtype(x.dtype, np.inexact) and not np.all(np.isfinite(x)))
+
+    for name, v in state.items():
+        if name == NONFINITE_STATE:
+            continue
+        if isinstance(v, CatBuffer):
+            if bool(np.asarray(v.has_nonfinite())):
+                return True
+        elif isinstance(v, (list, tuple)):
+            if any(_bad(x) for x in v):
+                return True
+        elif _bad(v):
+            return True
+    return False
+
+
+def state_poisoned(state: Dict[str, Any]) -> bool:
+    """THE exact eager poison verdict, shared by the health word, the
+    single-process compute guard, and the degradation corrupt-local check:
+    the latched per-update flag OR the whole-state scan (the per-update
+    screen skips CatBuffer bodies for cost; the scan here makes the verdict
+    exact). ``False`` when screening never registered the flag state.
+    Host-path only — callers guard against traced flags."""
+    flag = state.get(NONFINITE_STATE)
+    if flag is None:
+        return False
+    return int(np.asarray(flag)) > 0 or state_has_nonfinite(state)
+
+
+def build_health_word(
+    state: Dict[str, Any], reductions: Dict[str, Any], update_count: int = 0
+) -> np.ndarray:
+    """This rank's int32 health word for one metric's state dict.
+
+    Fixed shape ``[WORD_WIDTH]`` for EVERY metric, so the single
+    ``process_allgather`` of words is a well-formed collective no matter
+    how the ranks' metric definitions diverge. Host-path only (eager).
+    """
+    names, kinds = _state_kinds(state)
+    overflow = 0
+    for name in names:
+        if kinds[name] == "catbuf" and bool(np.asarray(state[name].overflowed)):
+            overflow = 1
+    nonfinite = 0
+    if kinds.get(NONFINITE_STATE) == "leaf":
+        nonfinite = int(state_poisoned(state))
+    counts = [_element_count(state[name], kinds[name]) for name in names]
+    slots = [-1] * COUNT_SLOTS
+    if len(counts) <= COUNT_SLOTS:
+        slots[: len(counts)] = counts
+    else:
+        slots[: COUNT_SLOTS - 1] = counts[: COUNT_SLOTS - 1]
+        # fold the tail: the minimum over its cat-family counts (the only
+        # kind whose zero is a divergence); -1 (no check) when none
+        tail_cat = [
+            c
+            for c, name in zip(counts[COUNT_SLOTS - 1 :], names[COUNT_SLOTS - 1 :])
+            if kinds[name] in ("catbuf", "list")
+        ]
+        slots[COUNT_SLOTS - 1] = min(tail_cat) if tail_cat else -1
+    word = [
+        HEALTH_PROTOCOL_VERSION,
+        state_schema_hash(state, reductions),
+        int(update_count),
+        overflow,
+        nonfinite,
+        len(names),
+    ] + slots
+    return np.asarray(word, dtype=np.int32)
+
+
+def verify_health_words(
+    words: np.ndarray,
+    state: Dict[str, Any],
+    reductions: Dict[str, Any],
+    *,
+    strict_update_count: bool = False,
+    metric_name: str = "metric",
+) -> None:
+    """Verify the gathered ``[world, width]`` health-word matrix.
+
+    Deterministic over input that is identical on every rank, so every rank
+    raises the same typed exception (or none) — the symmetric-failure
+    contract. Check order matters: emptiness is reported before schema so an
+    empty rank (whose unknown item spec perturbs the hash) gets the
+    actionable "no update() before sync()" message, not a schema complaint.
+    """
+    words = np.asarray(words)
+    world = words.shape[0]
+    names, kinds = _state_kinds(state)
+    if words.shape[1] != WORD_WIDTH:
+        # only reachable when a peer runs a protocol revision with a
+        # different fixed width (same-revision words are always WORD_WIDTH)
+        raise StateDivergenceError(
+            f"health word width mismatch for {metric_name}: got {words.shape[1]}, "
+            f"expected {WORD_WIDTH} — ranks are running different "
+            "metrics_tpu versions. All processes raised."
+        )
+
+    versions = words[:, _F_VERSION]
+    if not (versions == HEALTH_PROTOCOL_VERSION).all():
+        raise StateDivergenceError(
+            f"sync-header protocol version skew for {metric_name}: "
+            f"{sorted(set(versions.tolist()))} — ranks are running different "
+            "metrics_tpu versions. All processes raised."
+        )
+
+    # 0) state-count divergence: ranks don't even agree how many states
+    #    this metric has — the payload loop would desynchronize immediately
+    nstates = words[:, _F_NSTATES]
+    if not (nstates == len(names)).all():
+        raise StateDivergenceError(
+            f"State-count mismatch for {metric_name}: per-rank state counts "
+            f"{nstates.tolist()} vs local {len(names)} — ranks are running "
+            "different metric definitions. All processes raised together."
+        )
+
+    # 1) empty cat-family states — the symmetric replacement for the old
+    #    per-leaf count gathers (empty ranks cannot contribute a payload)
+    for j, name in enumerate(names[: COUNT_SLOTS - 1]):
+        if kinds[name] not in ("catbuf", "list"):
+            continue
+        col = words[:, _F_FIXED + j]
+        if (col == 0).any():
+            empty = np.nonzero(col == 0)[0].tolist()
+            raise StateDivergenceError(
+                f"Cannot sync state {name!r} of {metric_name} across {world} "
+                f"processes: process(es) {empty} have an empty state (no "
+                "update() before sync()). All processes raised together."
+            )
+    if len(names) > COUNT_SLOTS - 1 and any(
+        kinds[name] in ("catbuf", "list") for name in names[COUNT_SLOTS - 1 :]
+    ):
+        # folded tail slot: min over the tail's cat-family counts
+        col = words[:, _F_FIXED + COUNT_SLOTS - 1]
+        if (col == 0).any():
+            empty = np.nonzero(col == 0)[0].tolist()
+            raise StateDivergenceError(
+                f"Cannot sync {metric_name} across {world} processes: "
+                f"process(es) {empty} have an empty state beyond count slot "
+                f"{COUNT_SLOTS - 1} (no update() before sync()). All "
+                "processes raised together."
+            )
+
+    # 2) CatBuffer overflow: corrupt rows on any rank poison the merge
+    if (words[:, _F_OVERFLOW] != 0).any():
+        bad = np.nonzero(words[:, _F_OVERFLOW] != 0)[0].tolist()
+        raise SyncError(
+            f"Cannot sync {metric_name} across processes: process(es) {bad} "
+            "overflowed a CatBuffer capacity (rows were overwritten inside "
+            "jit). All processes raised. Use a larger `with_capacity(...)`."
+        )
+
+    # 3) NaN/Inf-poisoned accumulation (check_finite screening)
+    if (words[:, _F_NONFINITE] != 0).any():
+        bad = np.nonzero(words[:, _F_NONFINITE] != 0)[0].tolist()
+        raise NonFiniteStateError(
+            f"Cannot sync {metric_name} across processes: process(es) {bad} "
+            "accumulated non-finite (NaN/Inf) state values (check_finite "
+            "screening). All processes raised together."
+        )
+
+    # 4) schema divergence (dtype/item-shape/reduction mismatch)
+    schemas = words[:, _F_SCHEMA]
+    if not (schemas == schemas[0]).all():
+        raise StateDivergenceError(
+            f"State-schema mismatch for {metric_name}: ranks disagree on state "
+            "names/dtypes/item shapes/reductions (schema hashes "
+            f"{sorted(set(schemas.tolist()))}). The payload gather would be "
+            "ill-formed; all processes raised together."
+        )
+
+    # 5) update-count skew: legitimate under uneven data feeds (last-batch
+    #    raggedness), so a warning by default and fatal only under strict
+    updates = words[:, _F_UPDATES]
+    if not (updates == updates[0]).all():
+        msg = (
+            f"update-count skew for {metric_name}: per-rank update() counts "
+            f"{updates.tolist()} differ before sync."
+        )
+        if strict_update_count:
+            raise StateDivergenceError(msg + " All processes raised (strict mode).")
+        rank_zero_warn(
+            msg + " Proceeding (uneven feeds are legal); pass "
+            "strict_update_count=True to make this fatal.",
+            RuntimeWarning,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Liveness guards: sync watchdog + coordinator-bind retry
+# ---------------------------------------------------------------------------
+
+# Latched when a watchdog fires mid-collective: the abandoned worker thread
+# may still be inside the gather, so the process's NEXT collective can pair
+# with a peer's stale one and "succeed" with wrong data. host_sync_state
+# refuses to issue new collectives while the latch is set (degrading cleanly
+# under on_error="local") instead of corrupting silently.
+_channel_suspect = threading.Event()
+
+
+def channel_is_suspect() -> bool:
+    """True once a sync watchdog has fired: collective ordering is no longer
+    trusted and new host syncs are refused until :func:`reset_channel_health`."""
+    return _channel_suspect.is_set()
+
+
+def reset_channel_health() -> None:
+    """Clear the suspect latch — call only after the process group has been
+    re-established (or in tests that simulate the channel)."""
+    _channel_suspect.clear()
+
+
+def call_with_sync_watchdog(
+    fn: Callable[[], T], *, timeout: Optional[float] = None, what: str = "host collective"
+) -> T:
+    """Run ``fn`` under a thread-timer watchdog.
+
+    A host collective blocked on a dead or stalled peer blocks *forever* —
+    the worst failure mode a metrics library can hand an eval job. The
+    collective runs on a daemon worker thread; if it does not finish within
+    the timeout, :class:`SyncTimeoutError` is raised (the worker is left to
+    die with the process — a blocked collective cannot be cancelled from
+    Python). A timeout also latches the process-wide channel-suspect flag
+    (:func:`channel_is_suspect`): collective ordering can no longer be
+    trusted, so ``host_sync_state`` refuses new collectives until the
+    process group is re-established and :func:`reset_channel_health` is
+    called. Recover via ``on_error="local"`` or restart the process group.
+
+    ``timeout=None`` reads ``METRICS_TPU_SYNC_TIMEOUT_S`` (default 600);
+    a non-positive timeout disables the watchdog and calls ``fn`` inline.
+    """
+    timeout = get_sync_timeout(timeout)
+    if timeout <= 0:
+        return fn()
+    box: Dict[str, Any] = {}
+
+    def _run() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as err:  # noqa: BLE001 - re-raised on the caller thread
+            box["error"] = err
+
+    worker = threading.Thread(target=_run, name=f"metrics-tpu-watchdog[{what}]", daemon=True)
+    worker.start()
+    worker.join(timeout)
+    if worker.is_alive():
+        _channel_suspect.set()
+        raise SyncTimeoutError(
+            f"{what} did not complete within {timeout:g}s — a peer process is "
+            "likely dead or stalled. Raise METRICS_TPU_SYNC_TIMEOUT_S for slow "
+            "interconnects, or recover with Metric.sync(on_error='local')."
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def distributed_initialize_with_retry(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    *,
+    max_retries: int = 5,
+    base_backoff_s: float = 0.5,
+    initialize_fn: Optional[Callable[..., None]] = None,
+    **kwargs: Any,
+) -> None:
+    """``jax.distributed.initialize`` with exponential-backoff retry.
+
+    Coordinator binding has an inherent race: the usual free-port dance
+    (bind/close a probe socket, hand the port to workers) can lose the port
+    to another process, and non-coordinator ranks that dial before the
+    coordinator is up see transient connection errors. Both are *transient*
+    — retried here with exponential backoff plus rank-staggered jitter
+    (deterministic per process_id, so no RNG in the retry path). Errors
+    that don't look transient re-raise immediately; exhausting the budget
+    raises :class:`SyncTimeoutError` chained to the last error.
+
+    ``initialize_fn`` is the injection seam for tests (defaults to
+    ``jax.distributed.initialize``).
+    """
+    if initialize_fn is None:
+        import jax
+
+        initialize_fn = jax.distributed.initialize
+    transient_markers = (
+        "address already in use",
+        "connection refused",
+        "failed to connect",
+        "unavailable",
+        "deadline exceeded",
+        "bind",
+        "timed out",
+    )
+    last_err: Optional[BaseException] = None
+    for attempt in range(max_retries + 1):
+        try:
+            initialize_fn(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                **kwargs,
+            )
+            return
+        except Exception as err:  # noqa: BLE001 - classified below
+            text = str(err).lower()
+            if not any(marker in text for marker in transient_markers):
+                raise
+            last_err = err
+            if attempt == max_retries:
+                break
+            # stagger ranks so they don't re-collide on the same port/instant
+            delay = base_backoff_s * (2**attempt) * (1.0 + 0.1 * (process_id % 8))
+            time.sleep(delay)
+    raise SyncTimeoutError(
+        f"jax.distributed.initialize({coordinator_address!r}, rank {process_id}/"
+        f"{num_processes}) failed after {max_retries + 1} attempts: {last_err}"
+    ) from last_err

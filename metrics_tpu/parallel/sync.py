@@ -119,13 +119,33 @@ def sync_in_jit(
 # Host (out-of-jit, multi-process) path
 # ---------------------------------------------------------------------------
 
-def _process_allgather(x: Array) -> Array:
+def _raw_process_allgather(x: Array) -> Array:
+    """The bare cross-process collective.
+
+    Kept as its own seam so the fault-injection harness
+    (``tests/parallel/test_fault_injection.py``) can monkeypatch it to
+    simulate dead, slow, and divergent peers while the watchdog wrapper in
+    :func:`_process_allgather` stays in the loop.
+    """
     from jax.experimental import multihost_utils
 
     return jnp.asarray(multihost_utils.process_allgather(x))
 
 
-def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]:
+def _process_allgather(x: Array, timeout: Optional[float] = None) -> Array:
+    """Watchdog-guarded ``process_allgather``: raises
+    :class:`~metrics_tpu.utils.exceptions.SyncTimeoutError` instead of
+    blocking forever on a dead/stalled peer."""
+    from metrics_tpu.parallel.health import call_with_sync_watchdog
+
+    return call_with_sync_watchdog(
+        lambda: _raw_process_allgather(x), timeout=timeout, what="process_allgather"
+    )
+
+
+def gather_all_arrays(
+    result: Array, group: Optional[Any] = None, timeout: Optional[float] = None
+) -> List[Array]:
     """Gather one array from every process; supports uneven leading dims.
 
     Behavioral analogue of reference ``gather_all_tensors``
@@ -137,14 +157,14 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
     if world == 1:
         return [result]
     local_shape = jnp.asarray(result.shape, dtype=jnp.int32)
-    all_shapes = np.asarray(_process_allgather(local_shape))  # [world, ndim]
+    all_shapes = np.asarray(_process_allgather(local_shape, timeout=timeout))  # [world, ndim]
     max_shape = all_shapes.max(axis=0)
     if (all_shapes == all_shapes[0]).all():
-        gathered = _process_allgather(result)  # [world, ...]
+        gathered = _process_allgather(result, timeout=timeout)  # [world, ...]
         return [gathered[i] for i in range(world)]
     pad = [(0, int(m - s)) for s, m in zip(result.shape, max_shape)]
     padded = jnp.pad(result, pad)
-    gathered = _process_allgather(padded)
+    gathered = _process_allgather(padded, timeout=timeout)
     out = []
     for i in range(world):
         slices = tuple(slice(0, int(d)) for d in all_shapes[i])
@@ -152,33 +172,50 @@ def gather_all_arrays(result: Array, group: Optional[Any] = None) -> List[Array]
     return out
 
 
-def host_sync_leaf(value: Any, fx: ReduceFx) -> Any:
-    """Host-path sync of one state leaf across processes (eager)."""
+def host_sync_leaf(
+    value: Any,
+    fx: ReduceFx,
+    precheck: bool = True,
+    timeout: Optional[float] = None,
+) -> Any:
+    """Host-path sync of one state leaf across processes (eager).
+
+    ``precheck=True`` (standalone use) gathers the leaf's own count/overflow
+    words first so an empty or corrupted rank fails symmetrically with a
+    typed :class:`~metrics_tpu.utils.exceptions.SyncError`. When the caller
+    has already verified the whole state with the sync-header protocol
+    (:func:`host_sync_state`), pass ``precheck=False`` to skip the redundant
+    per-leaf collectives — that is how N sequential count/flag gathers
+    collapse into the one health-word gather.
+    """
     from metrics_tpu.core.cat_buffer import CatBuffer
+    from metrics_tpu.utils.exceptions import StateDivergenceError, SyncError
 
     if isinstance(value, CatBuffer):
         if not jit_distributed_available():
             return value.copy()
         world = jax.process_count()
-        # gather fill counts first so an empty rank fails symmetrically on all
-        # ranks (mirrors the list-state protocol below) instead of poisoning
-        # the merged buffer's shape/dtype with a placeholder
-        counts = np.asarray(_process_allgather(jnp.asarray(len(value), dtype=jnp.int32)))
-        if (counts == 0).any():
-            raise RuntimeError(
-                "Cannot sync a CatBuffer state across processes: at least one process "
-                "has an empty state (no update() before sync()). All processes raised."
+        if precheck:
+            # packed (count, overflow-flag) word: one collective for both
+            # symmetric checks instead of the historical two
+            word = np.asarray(
+                _process_allgather(
+                    jnp.asarray([len(value), int(bool(np.asarray(value.overflowed)))], jnp.int32),
+                    timeout=timeout,
+                )
             )
-        # overflow flags travel the same symmetric protocol: values() below
-        # would raise only on the corrupted rank and hang the rest mid-gather
-        flags = np.asarray(_process_allgather(jnp.asarray(value.overflowed, dtype=jnp.int32)))
-        if (flags != 0).any():
-            raise RuntimeError(
-                "Cannot sync a CatBuffer state across processes: at least one process "
-                "overflowed its capacity (rows were overwritten inside jit). "
-                "All processes raised. Use a larger `with_capacity(...)`."
-            )
-        pieces = gather_all_arrays(value.values())  # uneven rows handled
+            if (word[:, 0] == 0).any():
+                raise StateDivergenceError(
+                    "Cannot sync a CatBuffer state across processes: at least one process "
+                    "has an empty state (no update() before sync()). All processes raised."
+                )
+            if (word[:, 1] != 0).any():
+                raise SyncError(
+                    "Cannot sync a CatBuffer state across processes: at least one process "
+                    "overflowed its capacity (rows were overwritten inside jit). "
+                    "All processes raised. Use a larger `with_capacity(...)`."
+                )
+        pieces = gather_all_arrays(value.values(), timeout=timeout)  # uneven rows handled
         merged = CatBuffer(world * value.capacity)
         for p in pieces:
             merged.append(p)
@@ -191,19 +228,22 @@ def host_sync_leaf(value: Any, fx: ReduceFx) -> Any:
         )
         if not jit_distributed_available():
             return list(vals)
-        # all ranks first gather their element counts, so a rank with an empty
-        # list still participates in a collective (no one-sided hang); if any
-        # rank is empty, every rank raises the same error together.
-        counts = np.asarray(_process_allgather(jnp.asarray(len(vals), dtype=jnp.int32)))
-        if (counts == 0).any():
-            raise RuntimeError(
-                "Cannot sync a list-state across processes: at least one process has "
-                "an empty state (no update() before sync()). All processes raised."
+        if precheck:
+            # all ranks first gather their element counts, so a rank with an
+            # empty list still participates in a collective (no one-sided
+            # hang); if any rank is empty, every rank raises together.
+            counts = np.asarray(
+                _process_allgather(jnp.asarray(len(vals), dtype=jnp.int32), timeout=timeout)
             )
-        return list(gather_all_arrays(vals[0]))
+            if (counts == 0).any():
+                raise StateDivergenceError(
+                    "Cannot sync a list-state across processes: at least one process has "
+                    "an empty state (no update() before sync()). All processes raised."
+                )
+        return list(gather_all_arrays(vals[0], timeout=timeout))
     if not jit_distributed_available():
         return value
-    pieces = gather_all_arrays(jnp.asarray(value))
+    pieces = gather_all_arrays(jnp.asarray(value), timeout=timeout)
     if fx == "cat" or fx is None:
         return jnp.concatenate([p[None] if p.ndim == 0 else p for p in pieces], axis=0)
     gathered = jnp.stack(pieces, axis=0)
@@ -220,5 +260,64 @@ def host_sync_leaf(value: Any, fx: ReduceFx) -> Any:
     raise ValueError(f"Unknown dist_reduce_fx {fx!r}")
 
 
-def host_sync_state(state: Dict[str, Any], reductions: Dict[str, ReduceFx]) -> Dict[str, Any]:
-    return {name: host_sync_leaf(value, reductions.get(name)) for name, value in state.items()}
+def host_sync_state(
+    state: Dict[str, Any],
+    reductions: Dict[str, ReduceFx],
+    update_count: int = 0,
+    check_health: bool = True,
+    strict_update_count: bool = False,
+    timeout: Optional[float] = None,
+    metric_name: str = "metric",
+) -> Dict[str, Any]:
+    """Host-path sync of a whole metric-state dict across processes.
+
+    With ``check_health`` (the default in multi-process runs), every rank
+    first contributes one fixed-shape health word in a *single*
+    ``process_allgather`` (``parallel/health.py``): empty-state, overflow,
+    schema-mismatch, non-finite-poisoning and (strict) update-count-skew
+    divergences all raise the same typed ``SyncError`` subclass on every
+    rank *before* any payload gather, and the per-leaf count/flag
+    prechecks are skipped as redundant — one collective where the leaf
+    loop used to issue up to two per state.
+
+    Once a watchdog has fired anywhere in the process, the cross-process
+    channel is *suspect* (the abandoned worker may still sit inside the
+    timed-out gather, so a fresh collective could pair with a peer's stale
+    one and return wrong data without erroring) — further syncs raise
+    :class:`~metrics_tpu.utils.exceptions.SyncTimeoutError` immediately,
+    before issuing any collective, until
+    :func:`~metrics_tpu.parallel.health.reset_channel_health`.
+    """
+    if not jit_distributed_available():
+        return {name: host_sync_leaf(value, reductions.get(name)) for name, value in state.items()}
+    from metrics_tpu.parallel.health import channel_is_suspect
+
+    if channel_is_suspect():
+        from metrics_tpu.utils.exceptions import SyncTimeoutError
+
+        raise SyncTimeoutError(
+            f"host sync of {metric_name} refused: an earlier collective timed "
+            "out, so cross-process collective ordering can no longer be "
+            "trusted (a new gather could silently pair with a peer's stale "
+            "one). Recover with on_error='local' degradation, or restart the "
+            "process group and call "
+            "metrics_tpu.parallel.health.reset_channel_health()."
+        )
+    precheck = True
+    if check_health:
+        from metrics_tpu.parallel.health import build_health_word, verify_health_words
+
+        word = build_health_word(state, reductions, update_count=update_count)
+        words = np.asarray(_process_allgather(jnp.asarray(word), timeout=timeout))
+        verify_health_words(
+            words,
+            state,
+            reductions,
+            strict_update_count=strict_update_count,
+            metric_name=metric_name,
+        )
+        precheck = False
+    return {
+        name: host_sync_leaf(value, reductions.get(name), precheck=precheck, timeout=timeout)
+        for name, value in state.items()
+    }

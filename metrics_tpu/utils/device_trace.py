@@ -35,14 +35,18 @@ def parse_device_events(trace_dir: str) -> Dict[str, List[float]]:
     """Parse every ``*.trace.json.gz`` under ``trace_dir``.
 
     Returns ``{event_name: [duration_us, ...]}`` for complete events on
-    device pids only (process name ``/device:*``), durations in trace order.
+    device pids only (process name ``/device:*``), durations sorted
+    chronologically by the events' ``ts`` timestamps (ADVICE round 5: raw
+    ``traceEvents`` order is a serialization artifact, not execution order,
+    so positional pairing of two programs' k-th executions was unsound).
     """
     paths = sorted(
         glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True)
     )
     if not paths:
         raise FileNotFoundError(f"no *.trace.json.gz under {trace_dir}")
-    out: Dict[str, List[float]] = {}
+    # (ts, dur) pairs per event name, sorted by ts once all files are read
+    acc: Dict[str, List[Tuple[float, float]]] = {}
     for path in paths:
         with gzip.open(path, "rt") as fh:
             data = json.load(fh)
@@ -56,8 +60,10 @@ def parse_device_events(trace_dir: str) -> Dict[str, List[float]]:
         }
         for e in events:
             if e.get("ph") == "X" and e.get("pid") in device_pids:
-                out.setdefault(e["name"], []).append(float(e.get("dur", 0.0)))
-    return out
+                acc.setdefault(e["name"], []).append(
+                    (float(e.get("ts", 0.0)), float(e.get("dur", 0.0)))
+                )
+    return {name: [dur for _, dur in sorted(pairs)] for name, pairs in acc.items()}
 
 
 def _program_durations(events: Dict[str, List[float]], program: str) -> List[float]:
@@ -135,7 +141,12 @@ def measure_device_time_us(
     ``{name: (median_us, all_durations_us)}`` per device execution.
 
     Raises RuntimeError when a program produced no device events (e.g. a
-    CPU backend, which has no device timeline) — callers fall back to
+    CPU backend, which has no device timeline), or when the event count
+    disagrees with ``execs`` — one top-level device event per execution is
+    the matching contract, and a mismatch means the name matched extra
+    events (a colliding program name, multi-device duplication) or the
+    trace dropped executions; truncating to ``min(...)`` would silently
+    pair the wrong executions (ADVICE round 5). Callers fall back to
     wall-clock slope timing.
     """
     import jax
@@ -152,6 +163,13 @@ def measure_device_time_us(
             raise RuntimeError(
                 f"no device-timeline events for program {name!r} "
                 f"(device events seen: {sorted(dt.events)[:12]})"
+            )
+        if len(durs) != execs:
+            raise RuntimeError(
+                f"program {name!r} recorded {len(durs)} device executions, "
+                f"expected {execs}: the per-execution pairing is unsound "
+                "(name collision, multi-device duplication, or dropped trace "
+                "events) — refusing to truncate"
             )
         out[name] = (float(np.median(durs)), durs)
     return out

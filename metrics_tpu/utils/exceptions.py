@@ -1,11 +1,53 @@
-"""Error types for API misuse.
+"""Error types for API misuse and distributed-sync failures.
 
-TPU-native analogue of the reference's ``torchmetrics/utilities/exceptions.py:16``.
+TPU-native analogue of the reference's ``torchmetrics/utilities/exceptions.py:16``,
+extended with a typed hierarchy for cross-process synchronization faults.
+Cross-replica protocols only stay correct when every rank takes the identical
+branch (see ``parallel/health.py``), so sync failures are *classified*: the
+health-word protocol raises the same exception type, from the same gathered
+evidence, on every rank — never a one-sided raise that hangs the peers.
 """
 
 
 class MetricsTPUUserError(Exception):
     """Raised when the metrics-TPU API is used incorrectly (e.g. double-sync)."""
+
+
+class SyncError(RuntimeError):
+    """Base class for distributed metric-state synchronization failures.
+
+    Subclasses ``RuntimeError`` so callers of the pre-typed API (which raised
+    bare ``RuntimeError`` for empty/overflowed states) keep working. All
+    subclasses are raised *symmetrically*: every participating process sees
+    the same gathered health words and takes the same raise branch, so a
+    fault can never strand healthy ranks inside a collective.
+    """
+
+
+class SyncTimeoutError(SyncError):
+    """A host collective did not complete within the watchdog timeout.
+
+    The usual cause is a dead or stalled peer process. After this is raised
+    the process's collective ordering can no longer be trusted — recover via
+    ``on_error="local"`` degradation or by restarting the process group.
+    """
+
+
+class StateDivergenceError(SyncError):
+    """Metric state diverged across processes before a sync.
+
+    Covers the divergence classes the health word detects: a rank with an
+    empty cat-state, mismatched state schemas (names/dtypes/item shapes),
+    and update-count skew under strict checking.
+    """
+
+
+class NonFiniteStateError(SyncError):
+    """A rank's accumulated state was poisoned by NaN/Inf values.
+
+    Raised when ``check_finite`` screening is enabled and any participating
+    rank's poison flag is set (or locally, single-process, at compute time).
+    """
 
 
 # Alias kept for users migrating from the reference library.

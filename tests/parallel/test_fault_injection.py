@@ -1,0 +1,645 @@
+"""Fault-injection harness for the fault-tolerant host sync path (ISSUE 1).
+
+Single-process simulation of dead, slow, and divergent peers: the bare
+collective seam ``metrics_tpu.parallel.sync._raw_process_allgather`` is
+monkeypatched (while ``jax.process_count`` reports a fake world) so every
+divergence class travels the REAL production path — sync-header build,
+the single health-word ``process_allgather``, symmetric verification,
+watchdog, and ``on_error`` degradation — without spawning processes.
+The 2-process end-to-end complement lives in ``__graft_entry__
+.dryrun_multihost`` (a real divergent rank + ``on_error="local"``).
+
+Covers the acceptance matrix: every divergence class (empty state,
+overflow, schema mismatch, update-count skew, non-finite state, dead rank
+via injected timeout) raises the same typed exception on all ranks — zero
+hangs — and ``on_error="local"`` returns the local-only ``compute()``
+result with a warning instead of raising.
+"""
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.parallel.sync as sync_mod
+from metrics_tpu.core.cat_buffer import CatBuffer
+from metrics_tpu.parallel.health import (
+    COUNT_SLOTS,
+    HEALTH_PROTOCOL_VERSION,
+    NONFINITE_STATE,
+    WORD_WIDTH,
+    _F_FIXED,
+    _F_NONFINITE,
+    _F_NSTATES,
+    _F_OVERFLOW,
+    _F_SCHEMA,
+    _F_UPDATES,
+    _F_VERSION,
+    build_health_word,
+    call_with_sync_watchdog,
+    channel_is_suspect,
+    distributed_initialize_with_retry,
+    reset_channel_health,
+    state_has_nonfinite,
+    state_schema_hash,
+    verify_health_words,
+)
+from metrics_tpu.parallel.sync import host_sync_leaf, host_sync_state
+from metrics_tpu.utils.exceptions import (
+    MetricsTPUUserError,
+    NonFiniteStateError,
+    StateDivergenceError,
+    SyncError,
+    SyncTimeoutError,
+)
+from tests.helpers.testers import DummyListMetric, DummyMetricSum
+
+WORLD = 2
+
+
+class EchoAllgather:
+    """Fake ``process_allgather``: every peer contributes this rank's value.
+
+    ``mutate_first(rank1_word)`` (optional) edits what "rank 1" contributed
+    to the FIRST gather only — in ``host_sync_state`` that is always the
+    health-word collective, so a scenario can diverge the header while the
+    payload gathers (which must not run after a failed verify) stay honest.
+    ``delay_s`` simulates a slow (but live) interconnect.
+    """
+
+    def __init__(self, world=WORLD, mutate_first=None, delay_s=0.0):
+        self.world = world
+        self.mutate_first = mutate_first
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def __call__(self, x):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        rows = [np.asarray(x).copy() for _ in range(self.world)]
+        if self.calls == 1 and self.mutate_first is not None:
+            rows[1] = self.mutate_first(rows[1])
+        return jnp.asarray(np.stack(rows))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_channel():
+    # watchdog-timeout scenarios latch the process-wide channel-suspect
+    # flag by design; isolate it per test
+    reset_channel_health()
+    yield
+    reset_channel_health()
+
+
+@pytest.fixture
+def fake_world(monkeypatch):
+    """Install a fake 2-process world over the single-process test runner."""
+
+    def _install(allgather):
+        monkeypatch.setattr(jax, "process_count", lambda: allgather.world)
+        monkeypatch.setattr(sync_mod, "_raw_process_allgather", allgather)
+        return allgather
+
+    return _install
+
+
+def _sum_state():
+    return {"x": jnp.ones(())}, {"x": "sum"}
+
+
+def _catbuf_state(rows=3, capacity=8):
+    buf = CatBuffer(capacity)
+    buf.append(jnp.arange(rows, dtype=jnp.float32))
+    return {"preds": buf}, {"preds": "cat"}
+
+
+# ---------------------------------------------------------------------------
+# health word: build + schema hash
+# ---------------------------------------------------------------------------
+
+def test_health_word_layout():
+    state, reds = _catbuf_state(rows=3)
+    word = build_health_word(state, reds, update_count=7)
+    assert word.dtype == np.int32 and word.shape == (WORD_WIDTH,)
+    assert WORD_WIDTH == _F_FIXED + COUNT_SLOTS  # fixed width for EVERY metric
+    assert word[_F_VERSION] == HEALTH_PROTOCOL_VERSION
+    assert word[_F_UPDATES] == 7
+    assert word[_F_OVERFLOW] == 0 and word[_F_NONFINITE] == 0
+    assert word[_F_NSTATES] == 1
+    assert word[_F_FIXED] == 3  # CatBuffer fill count in the first slot
+    assert (word[_F_FIXED + 1 :] == -1).all()  # unused slots hold the sentinel
+
+    state["preds"].overflowed = jnp.ones((), jnp.bool_)
+    assert build_health_word(state, reds)[_F_OVERFLOW] == 1
+
+
+def test_schema_hash_ignores_batch_raggedness_not_config():
+    # uneven per-rank batches (leading dim) must hash equal...
+    a = {"v": jnp.zeros((3, 4))}
+    b = {"v": jnp.zeros((9, 4))}
+    reds = {"v": "cat"}
+    assert state_schema_hash(a, reds) == state_schema_hash(b, reds)
+    # ...but a mis-configured metric (different item shape / dtype /
+    # reduction / state names) must not
+    assert state_schema_hash({"v": jnp.zeros((3, 5))}, reds) != state_schema_hash(a, reds)
+    assert state_schema_hash(a, {"v": "sum"}) != state_schema_hash(a, reds)
+    assert state_schema_hash({"w": jnp.zeros((3, 4))}, {"w": "cat"}) != state_schema_hash(a, reds)
+
+
+# ---------------------------------------------------------------------------
+# symmetric verification: every divergence class, same typed raise on
+# every rank (verification is deterministic over the shared gathered matrix)
+# ---------------------------------------------------------------------------
+
+def _assert_symmetric_raise(exc_type, words, state, reds, **kwargs):
+    """Both simulated ranks verify the SAME gathered matrix → same raise."""
+    for _rank in range(WORLD):
+        with pytest.raises(exc_type):
+            verify_health_words(np.array(words), state, reds, **kwargs)
+
+
+@pytest.mark.parametrize(
+    "col, value, exc_type",
+    [
+        (_F_VERSION, 999, StateDivergenceError),  # software-version skew
+        (_F_SCHEMA, 12345, StateDivergenceError),  # num_classes-style mis-config
+        (_F_OVERFLOW, 1, SyncError),  # CatBuffer overflow on a peer
+        (_F_NONFINITE, 1, NonFiniteStateError),  # NaN/Inf-poisoned peer
+    ],
+    ids=["version-skew", "schema-mismatch", "overflow", "non-finite"],
+)
+def test_divergence_classes_raise_symmetrically(col, value, exc_type):
+    state, reds = _catbuf_state()
+    word = build_health_word(state, reds, update_count=1)
+    words = np.stack([word, word.copy()])
+    words[1, col] = value
+    _assert_symmetric_raise(exc_type, words, state, reds)
+
+
+def test_empty_peer_state_raises_before_schema():
+    # an empty rank's unknown item spec perturbs its schema hash too; the
+    # count check must win so the message says "no update()", not "schema"
+    state, reds = _catbuf_state()
+    word = build_health_word(state, reds)
+    empty = word.copy()
+    empty[_F_SCHEMA] = 0
+    empty[_F_FIXED] = 0
+    with pytest.raises(StateDivergenceError, match="empty state"):
+        verify_health_words(np.stack([word, empty]), state, reds)
+
+
+def test_update_count_skew_warns_by_default_raises_strict():
+    state, reds = _sum_state()
+    word = build_health_word(state, reds, update_count=4)
+    skew = word.copy()
+    skew[_F_UPDATES] = 3  # last-batch raggedness: one rank saw fewer steps
+    words = np.stack([word, skew])
+    with pytest.warns(RuntimeWarning, match="update-count skew"):
+        verify_health_words(words, state, reds)
+    _assert_symmetric_raise(
+        StateDivergenceError, words, state, reds, strict_update_count=True
+    )
+
+
+def test_healthy_words_verify_clean():
+    state, reds = _catbuf_state()
+    word = build_health_word(state, reds, update_count=2)
+    verify_health_words(np.stack([word, word]), state, reds)  # no raise
+
+
+# ---------------------------------------------------------------------------
+# host_sync_state through the injected collective: one header gather,
+# typed raise BEFORE any payload gather
+# ---------------------------------------------------------------------------
+
+def test_divergent_rank_raises_before_payload_gather(fake_world):
+    def diverge(word):
+        word[_F_SCHEMA] = (int(word[_F_SCHEMA]) + 1) & 0x7FFFFFFF
+        return word
+
+    ag = fake_world(EchoAllgather(mutate_first=diverge))
+    state, reds = _catbuf_state()
+    with pytest.raises(StateDivergenceError):
+        host_sync_state(state, reds, update_count=1)
+    # symmetric-failure contract: the raise happened on the header gather,
+    # so no rank can be stranded inside a later payload collective
+    assert ag.calls == 1
+
+
+def test_healthy_sync_collapses_per_leaf_prechecks(fake_world):
+    ag = fake_world(EchoAllgather())
+    state, reds = _catbuf_state(rows=3)
+    state["n"], reds["n"] = jnp.ones(()), "sum"
+    out = host_sync_state(state, reds, update_count=1)
+    # 1 header + per leaf (shape gather + payload gather) and ZERO per-leaf
+    # count/flag prechecks — the old protocol cost up to 2 extra per state
+    assert ag.calls == 1 + 2 * len(state)
+    assert len(out["preds"]) == WORLD * 3  # both ranks' rows merged
+    np.testing.assert_allclose(np.asarray(out["n"]), WORLD * 1.0)
+
+
+def test_slow_but_live_peer_completes_within_timeout(fake_world):
+    fake_world(EchoAllgather(delay_s=0.05))
+    state, reds = _sum_state()
+    out = host_sync_state(state, reds, timeout=30.0)
+    np.testing.assert_allclose(np.asarray(out["x"]), WORLD * 1.0)
+
+
+def test_dead_peer_raises_sync_timeout(fake_world):
+    fake_world(EchoAllgather(delay_s=3.0))  # "dead" at the watchdog's scale
+    state, reds = _sum_state()
+    t0 = time.perf_counter()
+    with pytest.raises(SyncTimeoutError, match="dead or stalled"):
+        host_sync_state(state, reds, timeout=0.2)
+    assert time.perf_counter() - t0 < 2.0  # raised, did not block out the call
+
+
+def test_watchdog_env_knob(fake_world, monkeypatch):
+    fake_world(EchoAllgather(delay_s=3.0))
+    monkeypatch.setenv("METRICS_TPU_SYNC_TIMEOUT_S", "0.2")
+    state, reds = _sum_state()
+    with pytest.raises(SyncTimeoutError):
+        host_sync_state(state, reds)
+
+
+def test_timeout_latches_channel_suspect_and_refuses_new_collectives(fake_world):
+    # after a watchdog fires, the abandoned worker may still sit inside the
+    # timed-out gather — a fresh collective could pair with a peer's stale
+    # one and "succeed" with wrong data. Further syncs must refuse up front.
+    ag = fake_world(EchoAllgather(delay_s=3.0))
+    state, reds = _sum_state()
+    with pytest.raises(SyncTimeoutError):
+        host_sync_state(state, reds, timeout=0.2)
+    assert channel_is_suspect()
+    calls_after_timeout = ag.calls
+    with pytest.raises(SyncTimeoutError, match="refused"):
+        host_sync_state(state, reds, timeout=30.0)
+    assert ag.calls == calls_after_timeout  # refused BEFORE any collective
+    # a re-established process group clears the latch explicitly
+    reset_channel_health()
+    assert not channel_is_suspect()
+
+
+def test_channel_suspect_degrades_under_on_error_local(fake_world):
+    # a collection syncing after one member timed out: remaining members
+    # degrade to local-only state instead of gambling on a desynced channel
+    ag = fake_world(EchoAllgather(delay_s=3.0))
+    first, second = DummyMetricSum(), DummyMetricSum()
+    for m in (first, second):
+        m.distributed_available_fn = lambda: True
+        m.update(jnp.asarray(1.0))
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        first.sync(on_error="local", timeout=0.2)
+    calls_after_timeout = ag.calls
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        second.sync(on_error="local", timeout=30.0)
+    assert ag.calls == calls_after_timeout  # no new collective was issued
+    np.testing.assert_allclose(np.asarray(second.x), 1.0)  # local state kept
+
+
+# ---------------------------------------------------------------------------
+# host_sync_leaf: single-process paths + standalone typed prechecks
+# (the satellite replacing the old bare-RuntimeError coverage)
+# ---------------------------------------------------------------------------
+
+def test_host_sync_leaf_single_process_passthrough():
+    # world == 1: no collectives; scalar/list pass through, CatBuffer copies
+    out = host_sync_leaf(jnp.asarray(2.0), "sum")
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    out = host_sync_leaf([jnp.asarray([1.0, 2.0])], "cat")
+    assert isinstance(out, list) and len(out) == 1
+    buf = CatBuffer(4)
+    buf.append(jnp.asarray([1.0]))
+    out = host_sync_leaf(buf, "cat")
+    assert isinstance(out, CatBuffer) and out is not buf and len(out) == 1
+
+
+def test_host_sync_leaf_empty_catbuffer_typed(fake_world):
+    fake_world(EchoAllgather())
+    with pytest.raises(StateDivergenceError, match="empty state"):
+        host_sync_leaf(CatBuffer(4), "cat")
+
+
+def test_host_sync_leaf_overflowed_catbuffer_typed(fake_world):
+    fake_world(EchoAllgather())
+    buf = CatBuffer(2)
+    buf.append(jnp.asarray([1.0, 2.0]))
+    buf.overflowed = jnp.ones((), jnp.bool_)
+    with pytest.raises(SyncError, match="overflowed"):
+        host_sync_leaf(buf, "cat")
+
+
+def test_host_sync_leaf_empty_list_typed(fake_world):
+    fake_world(EchoAllgather())
+    with pytest.raises(StateDivergenceError, match="empty state"):
+        host_sync_leaf([], "cat")
+
+
+def test_typed_errors_remain_runtime_errors():
+    # back-compat: callers catching the pre-typed bare RuntimeError keep
+    # working across the whole hierarchy
+    for exc in (SyncError, SyncTimeoutError, StateDivergenceError, NonFiniteStateError):
+        assert issubclass(exc, RuntimeError) and issubclass(exc, SyncError)
+
+
+# ---------------------------------------------------------------------------
+# Metric-level graceful degradation: on_error = raise | local | warn
+# ---------------------------------------------------------------------------
+
+def _distributed_metric(fake_world, allgather, metric=None):
+    fake_world(allgather)
+    m = metric if metric is not None else DummyMetricSum()
+    m.distributed_available_fn = lambda: True
+    return m
+
+
+def _schema_diverge(word):
+    word[_F_SCHEMA] = (int(word[_F_SCHEMA]) + 1) & 0x7FFFFFFF
+    return word
+
+
+def test_metric_sync_on_error_raise_default(fake_world):
+    m = _distributed_metric(fake_world, EchoAllgather(mutate_first=_schema_diverge))
+    m.update(jnp.asarray(1.0))
+    with pytest.raises(StateDivergenceError):
+        m.sync()
+    assert not m._is_synced and m._cache is None  # no half-synced residue
+
+
+def test_metric_on_error_local_degrades_to_local_compute(fake_world):
+    m = _distributed_metric(fake_world, EchoAllgather(mutate_first=_schema_diverge))
+    m.sync_on_error = "local"
+    m.update(jnp.asarray(1.0))
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        val = m.compute()  # compute()-time auto-sync threads on_error through
+    np.testing.assert_allclose(np.asarray(val), 1.0)  # local, not world-summed
+    assert not m._is_synced
+
+
+def test_metric_on_error_local_timeout_degrades(fake_world):
+    m = _distributed_metric(fake_world, EchoAllgather(delay_s=3.0))
+    m.update(jnp.asarray(1.0))
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        m.sync(on_error="local", timeout=0.2)
+    assert not m._is_synced
+    np.testing.assert_allclose(np.asarray(m.x), 1.0)
+
+
+def test_metric_on_error_warn_warns_on_every_rank(fake_world):
+    m = _distributed_metric(fake_world, EchoAllgather(mutate_first=_schema_diverge))
+    m.update(jnp.asarray(1.0))
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        m.sync(on_error="warn")
+    assert not m._is_synced
+
+
+def test_metric_healthy_sync_still_works(fake_world):
+    m = _distributed_metric(fake_world, EchoAllgather())
+    m.update(jnp.asarray(1.0))
+    m.sync()
+    assert m._is_synced
+    np.testing.assert_allclose(np.asarray(m.x), WORLD * 1.0)
+    m.unsync()
+    np.testing.assert_allclose(np.asarray(m.x), 1.0)
+
+
+def test_metric_strict_update_count_skew(fake_world):
+    def skew(word):
+        word[_F_UPDATES] = int(word[_F_UPDATES]) + 1
+        return word
+
+    m = _distributed_metric(fake_world, EchoAllgather(mutate_first=skew))
+    m.sync_strict_update_count = True
+    m.update(jnp.asarray(1.0))
+    with pytest.raises(StateDivergenceError, match="update-count skew"):
+        m.sync()
+
+
+def test_sync_on_error_validation():
+    with pytest.raises(MetricsTPUUserError, match="sync_on_error"):
+        DummyMetricSum(sync_on_error="ignore")
+    m = DummyMetricSum()
+    with pytest.raises(MetricsTPUUserError, match="on_error"):
+        m.sync(on_error="ignore", distributed_available=lambda: True)
+
+
+def test_sync_context_on_error_local_skips_unsync(fake_world):
+    m = _distributed_metric(fake_world, EchoAllgather(mutate_first=_schema_diverge))
+    m.update(jnp.asarray(1.0))
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        with m.sync_context(on_error="local") as synced:
+            np.testing.assert_allclose(np.asarray(synced.x), 1.0)
+    # exiting after a degraded sync must not raise "already un-synced"
+    assert not m._is_synced
+
+
+# ---------------------------------------------------------------------------
+# MetricCollection: all-or-nothing rollback / per-member degradation
+# ---------------------------------------------------------------------------
+
+def test_collection_rolls_back_on_member_failure(fake_world):
+    from metrics_tpu.core.collections import MetricCollection
+
+    fake_world(EchoAllgather())
+    good, bad = DummyMetricSum(), DummyListMetric()  # bad: empty cat state
+    mc = MetricCollection({"good": good, "bad": bad})
+    for m in mc.values():
+        m.distributed_available_fn = lambda: True
+    good.update(jnp.asarray(1.0))
+    with pytest.raises(StateDivergenceError):
+        mc.sync()
+    # the already-synced member was rolled back to local state
+    assert not good._is_synced and not bad._is_synced
+    np.testing.assert_allclose(np.asarray(good.x), 1.0)
+
+
+def test_collection_on_error_local_degrades_members_independently(fake_world):
+    from metrics_tpu.core.collections import MetricCollection
+
+    fake_world(EchoAllgather())
+    good, bad = DummyMetricSum(), DummyListMetric()
+    mc = MetricCollection({"good": good, "bad": bad})
+    for m in mc.values():
+        m.distributed_available_fn = lambda: True
+    good.update(jnp.asarray(1.0))
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        mc.sync(on_error="local")
+    # the healthy member still reports the global value; the sick one
+    # degraded to local-only instead of taking the job down
+    assert good._is_synced and not bad._is_synced
+    np.testing.assert_allclose(np.asarray(good.x), WORLD * 1.0)
+    mc.unsync()  # degraded members are skipped, synced ones restored
+    np.testing.assert_allclose(np.asarray(good.x), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# check_finite screening
+# ---------------------------------------------------------------------------
+
+def test_check_finite_latches_and_refuses_compute():
+    m = DummyMetricSum(check_finite=True)
+    m.update(jnp.asarray(1.0))
+    assert int(np.asarray(m._nonfinite)) == 0
+    m.update(jnp.asarray(jnp.nan))
+    assert int(np.asarray(m._nonfinite)) == 1
+    m.update(jnp.asarray(1.0))  # the flag latches: later finite updates
+    assert int(np.asarray(m._nonfinite)) == 1  # cannot clear the poison
+    with pytest.raises(NonFiniteStateError, match="non-finite"):
+        m.compute()
+
+
+def test_check_finite_clean_path_unaffected():
+    m = DummyMetricSum(check_finite=True)
+    m.update(jnp.asarray(2.0))
+    np.testing.assert_allclose(np.asarray(m.compute()), 2.0)
+    m.reset()
+    assert int(np.asarray(m._nonfinite)) == 0
+
+
+def test_enable_check_finite_after_update_rejected():
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    with pytest.raises(MetricsTPUUserError, match="before the first"):
+        m.enable_check_finite()
+
+
+def test_check_finite_poisoned_rank_fails_symmetrically(fake_world):
+    # the local rank itself is poisoned: its own health word carries the
+    # flag, so the header gather raises the typed error on every rank
+    m = _distributed_metric(fake_world, EchoAllgather(), DummyMetricSum(check_finite=True))
+    m.update(jnp.asarray(jnp.inf))
+    with pytest.raises(NonFiniteStateError):
+        m.sync()
+
+
+def test_check_finite_enforced_with_custom_dist_sync_fn():
+    # a custom transport bypasses the health header, but the poison flag
+    # rides it anyway (fx="sum"): every rank sees the same world-summed
+    # value post-sync and compute() must still refuse symmetrically
+    def seam(state, reductions):
+        # fake 2-rank transport: a poisoned peer contributes flag=1
+        out = dict(state)
+        out[NONFINITE_STATE] = jnp.asarray(state[NONFINITE_STATE], jnp.int32) + 1
+        out["x"] = jnp.asarray(state["x"]) * 2
+        return out
+
+    m = DummyMetricSum(check_finite=True, dist_sync_fn=seam)
+    m.distributed_available_fn = lambda: True
+    m.update(jnp.asarray(1.0))  # locally finite — only the "peer" is poisoned
+    with pytest.raises(NonFiniteStateError, match="participating process"):
+        m.compute()
+
+
+def test_update_count_ignores_trace_time_invocations():
+    # pure_update under jit re-enters _wrap_update with tracer args; retraces
+    # are a compilation artifact and must not skew the health word's counter
+    m = DummyMetricSum()
+    m.update(jnp.asarray(1.0))
+    assert m._update_count == 1
+
+    @jax.jit
+    def step(state, x):
+        return m.pure_update(state, x)
+
+    state = m.init_state()
+    for i in range(3):  # first call traces; all three go through pure_update
+        state = step(state, jnp.asarray(float(i)))
+    assert m._update_count == 1  # eager count only
+    np.testing.assert_allclose(np.asarray(state["x"]), 3.0)
+    # eager pure_update (warm-ups, bench loops) operates on an explicit
+    # state pytree — it must not skew the stateful accumulation's counter
+    m.pure_update(m.init_state(), jnp.asarray(5.0))
+    assert m._update_count == 1
+
+
+def test_unsync_tolerated_after_degraded_sync(fake_world):
+    # the documented sync -> state_dict -> unsync checkpoint pattern must
+    # not crash the very job on_error="local" just saved
+    m = _distributed_metric(fake_world, EchoAllgather(mutate_first=_schema_diverge))
+    m.update(jnp.asarray(1.0))
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        m.sync(on_error="local")
+    m.unsync()  # tolerated no-op, not "already un-synced"
+    np.testing.assert_allclose(np.asarray(m.x), 1.0)
+    # ...but the guard still fires for a genuinely unpaired unsync
+    with pytest.raises(MetricsTPUUserError, match="already been un-synced"):
+        m.unsync()
+
+
+def test_catbuffer_has_nonfinite():
+    buf = CatBuffer(4)
+    buf.append(jnp.asarray([1.0, 2.0]))
+    assert not bool(np.asarray(buf.has_nonfinite()))
+    buf.append(jnp.asarray([jnp.nan]))
+    assert bool(np.asarray(buf.has_nonfinite()))
+    ints = CatBuffer(4)
+    ints.append(jnp.asarray([1, 2]))
+    assert not bool(np.asarray(ints.has_nonfinite()))  # ints always finite
+
+
+# ---------------------------------------------------------------------------
+# watchdog + coordinator-bind retry primitives
+# ---------------------------------------------------------------------------
+
+def test_watchdog_passthrough_and_error_propagation():
+    assert call_with_sync_watchdog(lambda: 41 + 1, timeout=5.0) == 42
+
+    def boom():
+        raise ValueError("inner")
+
+    with pytest.raises(ValueError, match="inner"):
+        call_with_sync_watchdog(boom, timeout=5.0)
+
+
+def test_watchdog_disabled_runs_inline():
+    tid = call_with_sync_watchdog(threading.get_ident, timeout=0)
+    assert tid == threading.get_ident()  # no worker thread when disabled
+
+
+def test_watchdog_times_out():
+    with pytest.raises(SyncTimeoutError, match="did not complete"):
+        call_with_sync_watchdog(lambda: time.sleep(3.0), timeout=0.1, what="test gather")
+
+
+def test_initialize_retry_absorbs_transient_port_race():
+    attempts = []
+
+    def flaky(**kwargs):
+        attempts.append(kwargs)
+        if len(attempts) < 3:
+            raise RuntimeError("Address already in use: 127.0.0.1:9999")
+
+    distributed_initialize_with_retry(
+        "localhost:9999", 2, 0, base_backoff_s=0.001, initialize_fn=flaky
+    )
+    assert len(attempts) == 3
+    assert attempts[0]["coordinator_address"] == "localhost:9999"
+
+
+def test_initialize_retry_nontransient_raises_immediately():
+    calls = []
+
+    def broken(**kwargs):
+        calls.append(1)
+        raise ValueError("invalid process_id")
+
+    with pytest.raises(ValueError):
+        distributed_initialize_with_retry(
+            "localhost:9999", 2, 0, base_backoff_s=0.001, initialize_fn=broken
+        )
+    assert len(calls) == 1
+
+
+def test_initialize_retry_exhaustion_is_typed_and_chained():
+    def always_down(**kwargs):
+        raise RuntimeError("failed to connect to coordinator")
+
+    with pytest.raises(SyncTimeoutError, match="failed after 3 attempts") as ei:
+        distributed_initialize_with_retry(
+            "localhost:9999", 2, 1, max_retries=2, base_backoff_s=0.001,
+            initialize_fn=always_down,
+        )
+    assert isinstance(ei.value.__cause__, RuntimeError)

@@ -14,6 +14,11 @@ import jax.numpy as jnp
 import os
 
 import numpy as np
+import pytest
+
+# gate, don't crash collection: environments without the fuzzing dep still
+# run the rest of the suite (the driver image does not guarantee hypothesis)
+hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 from jax.sharding import Mesh, PartitionSpec as P
 from sklearn.metrics import accuracy_score, mean_squared_error as sk_mse, roc_auc_score

@@ -10,6 +10,10 @@ import os
 
 import numpy as np
 import pytest
+
+# gate, don't crash collection: environments without the fuzzing dep still
+# run the rest of the suite (the driver image does not guarantee hypothesis)
+hypothesis = pytest.importorskip("hypothesis")
 from hypothesis import given, settings, strategies as st
 from scipy.stats import spearmanr
 from sklearn.metrics import average_precision_score
