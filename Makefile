@@ -45,7 +45,7 @@ docs:
 	python docs/generate_reference.py
 
 # benchmark contract line (TPU when the tunnel is alive, CPU fallback otherwise);
-# `--all` additionally runs configs 2-7
+# `--all` additionally runs configs 2-8 (8 = host-sync collective-fusion counts)
 bench:
 	python bench.py
 

@@ -3,9 +3,10 @@
 Default (driver contract): runs BASELINE config 1 and prints ONE JSON line
 ``{"metric", "value", "unit", "vs_baseline"}``.
 
-``python bench.py --all`` additionally runs BASELINE configs 2-7 (one JSON
-line each; ``--config N`` runs a single one; see BASELINE.md for the config
-table and BENCH.md for recorded numbers).
+``python bench.py --all`` additionally runs configs 2-8 (one JSON line
+each; ``--config N`` runs a single one; see BASELINE.md for the config
+table and BENCH.md for recorded numbers; config 8 is the host-sync
+collective-fusion accounting added with the bucketed planner).
 
 Timing methodology (see BENCH.md): hot paths are timed **on-chip** by
 scanning K steps inside ONE jitted program (``lax.scan``) and dividing — a
@@ -107,8 +108,12 @@ def _enable_persistent_compile_cache() -> None:
     try:
         from metrics_tpu.utils import compile_cache
 
-        cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
-        path = compile_cache.enable(cache_dir, min_compile_seconds=2)
+        # METRICS_TPU_COMPILE_CACHE overrides the repo-local default (an
+        # operator pointing several bench runs at one shared cache dir)
+        path = compile_cache.enable_from_env(min_compile_seconds=2)
+        if path is None:
+            cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+            path = compile_cache.enable(cache_dir, min_compile_seconds=2)
         pre_warmed = bool(os.listdir(path))
         _diag(compile_cache=("warm" if pre_warmed else "cold"), dir=path)
     except Exception as e:  # noqa: BLE001 — cache is an optimization, never fatal
@@ -1160,6 +1165,168 @@ def bench_config6() -> None:
         )
 
 
+def bench_config8() -> None:
+    """Config 8: host-sync collective fusion — fused vs per-leaf counts.
+
+    The ISSUE-2 acceptance measurement: a MetricCollection of ≥3 metrics /
+    ≥6 state leaves host-syncs through the bucketed planner
+    (`parallel/bucketing.py`) and through the per-leaf path, with the bare
+    collective seam (`_raw_process_allgather`) replaced by a counting echo
+    gather at a simulated W=8 world — the counts and payload shapes are the
+    real protocol's, only the transport is faked (multi-chip hardware is
+    unavailable; same split as config 2's sync-term bound). Emits the fused
+    collective count with `vs_baseline` = per-leaf/fused ratio, plus a W=8
+    sync-term *bound*: collectives × per-collective launch floor + payload
+    bytes over DCN (host gathers ride the data-center network, not ICI —
+    1 ms/collective launch floor and 3 GB/s are the conservative knobs,
+    both reported in the diagnostic for re-derivation).
+
+    Asserts (CI gates contract) that the fused path issues FEWER collectives
+    than the collection has leaves, and no more than 1 header + one per
+    dtype/fx bucket.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import metrics_tpu.parallel.sync as sync_mod
+    from metrics_tpu.core.collections import MetricCollection
+    from metrics_tpu.core.metric import Metric
+    from metrics_tpu.parallel.bucketing import build_sync_plan, clear_sync_plan_cache
+
+    W = 8
+
+    class _CountingEcho:
+        """W-rank echo gather: every peer contributes this rank's payload."""
+
+        def __init__(self):
+            self.calls = 0
+            self.bytes = 0
+
+        def __call__(self, x):
+            self.calls += 1
+            row = np.asarray(x)
+            self.bytes += row.nbytes * W
+            return jnp.asarray(np.stack([row.copy() for _ in range(W)]))
+
+    class _Avg(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + jnp.sum(x)
+            self.count = self.count + jnp.asarray(jnp.size(x), jnp.int32)
+
+        def compute(self):
+            return self.total / self.count
+
+    class _Extrema(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("mx", jnp.full((NUM_CLASSES,), -jnp.inf), dist_reduce_fx="max")
+            self.add_state("mn", jnp.full((NUM_CLASSES,), jnp.inf), dist_reduce_fx="min")
+
+        def update(self, x):
+            self.mx = jnp.maximum(self.mx, x)
+            self.mn = jnp.minimum(self.mn, x)
+
+        def compute(self):
+            return self.mx - self.mn
+
+    class _Hist(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("hist", jnp.zeros((32,), jnp.int32), dist_reduce_fx="sum")
+            self.add_state("seen", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.hist = self.hist + jnp.histogram(x, bins=32, range=(0.0, 1.0))[0].astype(jnp.int32)
+            self.seen = self.seen + 1.0
+
+        def compute(self):
+            return self.hist
+
+    class _Curve(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("preds", [], dist_reduce_fx="cat")
+            self.add_state("target", [], dist_reduce_fx="cat")
+
+        def update(self, p, t):
+            self.preds.append(p)
+            self.target.append(t)
+
+        def compute(self):
+            return jnp.concatenate(self.preds)
+
+    rng = np.random.RandomState(0)
+
+    def make_states():
+        mc = MetricCollection(
+            {"avg": _Avg(), "ext": _Extrema(), "hist": _Hist(), "curve": _Curve()}
+        )
+        x = jnp.asarray(rng.rand(256).astype(np.float32))
+        mc["avg"].update(x)
+        mc["ext"].update(jnp.asarray(rng.rand(NUM_CLASSES).astype(np.float32)))
+        mc["hist"].update(x)
+        mc["curve"].update(x[:100], jnp.asarray(rng.randint(0, 2, 100), jnp.int32))
+        combined, reds = {}, {}
+        for key, m in mc.items():
+            for name, v in m._state.items():
+                combined[f"{key}.{name}"] = v
+                reds[f"{key}.{name}"] = m._reductions.get(name)
+        return mc, combined, reds
+
+    saved_count, saved_seam = jax.process_count, sync_mod._raw_process_allgather
+    try:
+        jax.process_count = lambda: W
+        counts = {}
+        for mode in ("fused", "per_leaf"):
+            clear_sync_plan_cache()
+            echo = _CountingEcho()
+            sync_mod._raw_process_allgather = echo
+            _mc, combined, reds = make_states()
+            sync_mod.host_sync_state(combined, reds, update_count=1, timeout=0,
+                                     fused=(mode == "fused"))
+            counts[mode] = {"collectives": echo.calls, "bytes": echo.bytes}
+        plan = build_sync_plan(combined, reds)
+        n_leaves = len(combined)
+    finally:
+        jax.process_count = saved_count
+        sync_mod._raw_process_allgather = saved_seam
+        clear_sync_plan_cache()
+
+    fused_n = counts["fused"]["collectives"]
+    leaf_n = counts["per_leaf"]["collectives"]
+    # the CI gates contract: fusion must beat one-collective-per-leaf and
+    # stay within the planner's 1 header + one-per-bucket budget
+    assert fused_n < n_leaves, f"fused path issued {fused_n} >= leaves {n_leaves}"
+    assert fused_n <= 1 + plan.n_buckets, (fused_n, plan.n_buckets)
+
+    # W=8 sync-term bound: host collectives ride DCN with a per-collective
+    # launch floor that dominates small metric payloads — which is exactly
+    # why collective COUNT is the lever this config measures
+    launch_ms, dcn_gbps = 1.0, 3.0
+    bound = {
+        mode: round(c["collectives"] * launch_ms + c["bytes"] / (dcn_gbps * 1e9) * 1e3, 3)
+        for mode, c in counts.items()
+    }
+    _diag(
+        config=8,
+        world=W,
+        leaves=n_leaves,
+        buckets=plan.n_buckets,
+        per_leaf_collectives=leaf_n,
+        fused_collectives=fused_n,
+        payload_bytes={m: c["bytes"] for m, c in counts.items()},
+        sync_term_w8_ms_bound=bound,
+        assumed={"launch_ms_per_collective": launch_ms, "dcn_gbps": dcn_gbps},
+    )
+    _emit("fused_sync_collectives", fused_n, "collectives/sync",
+          round(leaf_n / fused_n, 3))
+
+
 def main() -> None:
     try:
         platform = _ensure_backend()
@@ -1185,7 +1352,7 @@ def main() -> None:
     except Exception:
         vs = None
     _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
-    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7}
+    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8}
     if "--config" in sys.argv:
         i = sys.argv.index("--config") + 1
         key = sys.argv[i] if i < len(sys.argv) else None

@@ -9,8 +9,9 @@ from contextlib import contextmanager
 from copy import deepcopy
 from typing import Any, Callable, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
-from metrics_tpu.core.metric import Metric
-from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from metrics_tpu.core.metric import _ON_ERROR_MODES, Metric, _copy_state_value
+from metrics_tpu.parallel.health import FUSED_KEY_SEP as _FUSED_KEY_SEP
+from metrics_tpu.utils.exceptions import MetricsTPUUserError, SyncError
 
 
 class MetricCollection(dict):
@@ -23,6 +24,13 @@ class MetricCollection(dict):
     program, so a whole collection's update costs one fused kernel launch
     and its distributed sync batches into one collective round — the
     design BASELINE's north-star (<1% metric overhead) is built on.
+    On the host path, :meth:`sync` combines every member's states into a
+    single bucketed plan (``parallel/bucketing.py``): one health header
+    plus one collective per dtype/fx class for the WHOLE collection —
+    O(#dtypes × #fx-classes) instead of O(#metrics × #leaves) — with
+    results bit-identical to the per-member loop and the same
+    all-or-nothing / per-member-degradation failure semantics
+    (``METRICS_TPU_FUSED_SYNC=0`` restores the per-member loop).
     ``clone(prefix=...)`` gives cheap train/val/test copies.
 
     Example:
@@ -179,13 +187,43 @@ class MetricCollection(dict):
     ) -> None:
         """Host-sync every member, threading the fault-tolerance knobs.
 
-        All-or-nothing under ``on_error="raise"``: if a member's sync raises
-        a typed ``SyncError`` mid-way, the members already synced are rolled
-        back to their local state before the error propagates, so the
-        collection is never left half-synced. Under ``"local"``/``"warn"``
-        each member degrades independently (``Metric.sync`` swallows the
-        error per member) and healthy members still report global values.
+        Default transport is the **collection-fused** path: all members'
+        states combine into one key-prefixed dict and sync through a single
+        bucketed plan (``parallel/bucketing.py``) — one health header plus
+        one collective per dtype/fx class for the WHOLE collection, instead
+        of O(#metrics × #leaves). ``METRICS_TPU_FUSED_SYNC=0`` (or any
+        member's ``sync_fused=False``) restores the per-member loop.
+
+        Failure semantics are preserved from the per-member protocol:
+
+        - all-or-nothing under ``on_error="raise"`` — the fused sync raises
+          before any member state is touched (no rollback needed); on the
+          per-member loop, already-synced members are rolled back before
+          the error propagates, so the collection is never left half-synced;
+        - under ``"local"``/``"warn"`` a failed fused sync falls back to the
+          per-member loop so each member degrades *independently* — healthy
+          members still report global values while sick ones keep local
+          state (``Metric.sync`` swallows the error per member).
         """
+        if on_error is not None and on_error not in _ON_ERROR_MODES:
+            raise MetricsTPUUserError(
+                f"`on_error` must be one of {_ON_ERROR_MODES}, got {on_error!r}"
+            )
+        if should_sync and dist_sync_fn is None and self._fused_sync_eligible(distributed_available):
+            try:
+                self._sync_fused(timeout=timeout)
+                return
+            except SyncError:
+                modes = [
+                    on_error if on_error is not None else getattr(m, "sync_on_error", "raise")
+                    for m in self.values()
+                ]
+                if all(mode == "raise" for mode in modes):
+                    raise  # nothing was synced: all-or-nothing holds trivially
+                # degradation requested somewhere: re-run per member so each
+                # applies its own on_error (healthy members still get global
+                # values; the verify outcome is identical on every rank, so
+                # all ranks fall back together and collectives stay aligned)
         synced: List[Metric] = []
         try:
             for m in self.values():
@@ -202,6 +240,88 @@ class MetricCollection(dict):
             for m in synced:
                 m.unsync()
             raise
+
+    def _fused_sync_eligible(self, distributed_available: Optional[Callable]) -> bool:
+        """Can this collection sync through one combined bucketed plan?
+
+        Requires the built-in transport on every member (no ``dist_sync_fn``,
+        no ``process_group``), a distributed world, no member already synced
+        (the per-member loop raises the proper "already synced" error), and
+        the fused knob on (env default; any member's ``sync_fused=False``
+        opts the whole collection out).
+        """
+        from metrics_tpu.parallel.bucketing import fused_sync_enabled
+
+        members = list(self.values())
+        if not members or not fused_sync_enabled():
+            return False
+        if any(
+            m.dist_sync_fn is not None
+            or m.process_group is not None
+            or m._is_synced
+            or getattr(m, "sync_fused", None) is False
+            # strict update-count checking is per member: the combined
+            # header carries one summed count column, which would escalate
+            # strictness onto non-strict members (and opposite-direction
+            # skews could cancel in the sum) — strict members keep the
+            # per-member loop's exact semantics
+            or getattr(m, "sync_strict_update_count", False)
+            for m in members
+        ):
+            return False
+        if any(_FUSED_KEY_SEP in key for key in self.keys()):
+            return False
+        for m in members:
+            avail = (
+                distributed_available
+                if distributed_available is not None
+                else m.distributed_available_fn
+            )
+            if not avail():
+                return False
+        return True
+
+    def _sync_fused(self, timeout: Optional[float] = None) -> None:
+        """One bucketed plan over every member's states.
+
+        The combined header's ``update_count`` column carries the SUM of
+        member counts — a best-effort skew indicator only (opposite-
+        direction member skews can cancel), which is why strict-mode
+        members are excluded from fused eligibility and keep the exact
+        per-member check. Raises the typed ``SyncError`` before any member
+        state is mutated — all-or-nothing without rollback.
+        """
+        from metrics_tpu.parallel.sync import host_sync_state
+
+        members = list(super().items())
+        combined: Dict[str, Any] = {}
+        reductions: Dict[str, Any] = {}
+        for key, m in members:
+            for name, value in m._state.items():
+                combined[f"{key}{_FUSED_KEY_SEP}{name}"] = value
+                reductions[f"{key}{_FUSED_KEY_SEP}{name}"] = m._reductions.get(name)
+        member_timeouts = [
+            t for _, m in members if (t := getattr(m, "sync_timeout", None)) is not None
+        ]
+        effective_timeout = timeout if timeout is not None else (
+            min(member_timeouts) if member_timeouts else None
+        )
+        synced = host_sync_state(
+            combined,
+            reductions,
+            update_count=sum(getattr(m, "_update_count", 0) for _, m in members),
+            timeout=effective_timeout,
+            metric_name=f"MetricCollection[{', '.join(k for k, _ in members)}]",
+            fused=True,
+        )
+        # snapshot each member's pre-sync state only now: the sync never
+        # mutates its inputs, and a failed attempt (the common case the
+        # on_error fallback exists for) must not pay for full state copies
+        for key, m in members:
+            m._cache = {k: _copy_state_value(v) for k, v in m._state.items()}
+            m._sync_degraded = False
+            m._restore({name: synced[f"{key}{_FUSED_KEY_SEP}{name}"] for name in m._state})
+            m._is_synced = True
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore every synced member's pre-sync local state.
@@ -249,22 +369,26 @@ class MetricCollection(dict):
             for k, m in super().items()
         }
 
-    def pure_sync(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Any]:
+    def pure_sync(
+        self, state: Dict[str, Any], axis_name: Optional[Any] = None, fused: bool = False
+    ) -> Dict[str, Any]:
         """Collective-sync member states over ``axis_name``.
 
         ``axis_name=None``: each member syncs over its own declared
         ``process_group``; members without one keep their local state (what
         their standalone ``pure_forward`` would do). Raises if no member
-        declares a group — there would be nothing to sync."""
+        declares a group — there would be nothing to sync. ``fused=True``
+        buckets each member's same-dtype/same-fx reduce leaves into one
+        collective op (``sync_in_jit`` fused mode)."""
         if axis_name is not None:
-            return {k: m.pure_sync(state[k], axis_name) for k, m in super().items()}
+            return {k: m.pure_sync(state[k], axis_name, fused=fused) for k, m in super().items()}
         if all(m.process_group is None for m in super().values()):
             raise MetricsTPUUserError(
                 "pure_sync needs a mesh axis: pass `axis_name=` or construct "
                 "at least one member with `process_group=<axis or tuple>`."
             )
         return {
-            k: m.pure_sync(state[k]) if m.process_group is not None else state[k]
+            k: m.pure_sync(state[k], fused=fused) if m.process_group is not None else state[k]
             for k, m in super().items()
         }
 

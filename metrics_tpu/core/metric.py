@@ -201,6 +201,14 @@ class Metric:
     (:meth:`merge_state`) used for checkpoint-resume and rank-strided
     accumulation.
 
+    **Fused host sync.** After the sync header verifies, the host payload
+    defaults to the bucketed planner (``parallel/bucketing.py``): reduce
+    leaves grouped by ``(dtype, fx)`` and cat-family leaves by dtype sync
+    in O(#dtypes × #fx-classes) collectives instead of one-or-more per
+    leaf, bit-identical to the per-leaf path. Opt out process-wide with
+    ``METRICS_TPU_FUSED_SYNC=0`` or per metric via the ``sync_fused``
+    attribute (see ``docs/fault_tolerance.md``).
+
     Args:
         compute_on_step: return the metric value for the current batch from
             ``forward`` (reference ``metric.py:73``).
@@ -244,6 +252,13 @@ class Metric:
     #: rank) instead of a rank-zero warning. Plain attribute so it can be
     #: flipped on any constructed metric.
     sync_strict_update_count: bool = False
+
+    #: Per-metric override of the bucketed (fused) host-sync payload path:
+    #: ``None`` follows the ``METRICS_TPU_FUSED_SYNC`` env knob (default on),
+    #: ``False`` forces the per-leaf path, ``True`` forces fused. Plain
+    #: attribute so it can be flipped on any constructed metric; results are
+    #: bit-identical either way (``parallel/bucketing.py``).
+    sync_fused: Optional[bool] = None
 
     def __init__(
         self,
@@ -508,6 +523,7 @@ class Metric:
             strict_update_count=self.sync_strict_update_count,
             timeout=timeout if timeout is not None else getattr(self, "sync_timeout", None),
             metric_name=type(self).__name__,
+            fused=getattr(self, "sync_fused", None),
         )
 
     def sync(
@@ -688,13 +704,17 @@ class Metric:
         finally:
             self._state, self._computed = saved, saved_computed
 
-    def pure_sync(self, state: Dict[str, Any], axis_name: Optional[Any] = None) -> Dict[str, Any]:
+    def pure_sync(
+        self, state: Dict[str, Any], axis_name: Optional[Any] = None, fused: bool = False
+    ) -> Dict[str, Any]:
         """In-jit cross-device sync over named mesh axes (psum/all_gather).
 
         ``axis_name`` may be one axis name or a tuple of names; defaults to
         the constructor's ``process_group`` (the mesh-native sub-group:
         syncing over a subset of a multi-axis mesh leaves one independent
-        value per slice of the remaining axes).
+        value per slice of the remaining axes). ``fused=True`` buckets
+        same-dtype/same-fx reduce leaves into one collective op each
+        (identical values, fewer collectives for XLA to schedule).
         """
         if axis_name is None:
             axis_name = self.process_group
@@ -703,7 +723,7 @@ class Metric:
                 "pure_sync needs a mesh axis: pass `axis_name=` or construct "
                 "the metric with `process_group=<axis or tuple of axes>`."
             )
-        return sync_in_jit(state, self._reductions, axis_name)
+        return sync_in_jit(state, self._reductions, axis_name, fused=fused)
 
     def pure_forward(
         self, state: Dict[str, Any], *args: Any, axis_name: Optional[str] = None, **kwargs: Any

@@ -1,3 +1,11 @@
+from metrics_tpu.parallel.bucketing import (
+    SyncPlan,
+    build_sync_plan,
+    clear_sync_plan_cache,
+    fused_sync_enabled,
+    host_sync_state_bucketed,
+    sync_plan_cache_info,
+)
 from metrics_tpu.parallel.health import (
     NONFINITE_STATE,
     build_health_word,

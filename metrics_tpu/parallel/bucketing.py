@@ -1,0 +1,350 @@
+"""Bucketed host-path sync: one collective per dtype/fx class, not per leaf.
+
+The health-word protocol (``parallel/health.py``) collapsed the *precheck*
+collectives into a single gather, but the *payload* path still issued one
+``process_allgather`` per state leaf — plus a shape pre-gather per uneven
+leaf — and a ``MetricCollection`` multiplied that by the number of metrics.
+Collective fusion is exactly the lever the related work pulls (EQuARX,
+arxiv 2506.17615: fused quantized AllReduce; portable collective
+redistribution, arxiv 2112.01075: many small transfers batched into few
+large ones): latency hides in per-collective launch overhead, so the fix is
+to move the same bytes in O(#dtypes × #fx-classes) collectives.
+
+This module is the **bucketed sync planner**. Given the state dict of one
+metric — or the combined, key-prefixed states of an entire
+``MetricCollection`` (``MetricCollection.sync``) — it classifies every leaf
+and builds a :class:`SyncPlan`:
+
+- **reduce leaves** (``fx`` in ``sum``/``mean``/``max``/``min``) group by
+  ``(dtype, fx)``: each bucket flattens and concatenates into one flat
+  buffer, gathers once to ``[world, total]``, applies the reduction over the
+  world axis, and splits back — elementwise over the same ``world`` values
+  as the per-leaf path, so results are bit-identical;
+- **cat-family leaves** (CatBuffer, list states, arrays with ``fx`` in
+  ``("cat", None)``) group by dtype into one padded ragged buffer: each rank
+  flattens its rows leaf-by-leaf, pads to the max total across ranks (known
+  from the header's length columns — no shape pre-gathers), gathers once,
+  and every rank slices each leaf's per-rank pieces back out;
+- **callable-``fx`` leaves** cannot be planned (opaque reduction) and fall
+  back to :func:`~metrics_tpu.parallel.sync.host_sync_leaf`.
+
+The static plan (leaf order, bucket membership, item shapes/sizes) is
+cached keyed on the exact schema string behind the health word's CRC
+(:func:`~metrics_tpu.parallel.health.state_schema_parts` — the full string,
+so a CRC collision can never alias two schemas onto one plan), so repeated
+``compute()`` calls pay zero re-planning. Per-rank row counts — the only
+dynamic input — ride the header gather's length columns.
+
+Execution requires the caller to have *already verified* the gathered
+health words: the plan trusts cross-rank schema equality (verified via the
+schema hash), non-empty cat states (count columns), and un-overflowed
+CatBuffers (overflow column). ``host_sync_state`` wires this up and is the
+supported entry point; the ``METRICS_TPU_FUSED_SYNC=0`` env knob is the
+escape hatch back to the per-leaf path.
+"""
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.parallel.health import (
+    cat_family_names,
+    cat_row_count,
+    header_cat_lengths,
+    state_schema_parts,
+)
+
+__all__ = [
+    "LeafSpec",
+    "SyncPlan",
+    "build_sync_plan",
+    "clear_sync_plan_cache",
+    "fused_sync_enabled",
+    "host_sync_state_bucketed",
+    "sync_plan_cache_info",
+]
+
+#: Env escape hatch: set to 0/false/off to restore the per-leaf payload path.
+FUSED_SYNC_ENV = "METRICS_TPU_FUSED_SYNC"
+
+_REDUCERS = {
+    "sum": lambda g: jnp.sum(g, axis=0),
+    "mean": lambda g: jnp.mean(g, axis=0),
+    "max": lambda g: jnp.max(g, axis=0),
+    "min": lambda g: jnp.min(g, axis=0),
+}
+
+
+def fused_sync_enabled() -> bool:
+    """Default payload strategy: bucketed (fused) unless the env knob opts out."""
+    return os.environ.get(FUSED_SYNC_ENV, "1").strip().lower() not in ("0", "false", "off", "no")
+
+
+class LeafSpec:
+    """Static per-leaf plan entry.
+
+    ``kind`` ∈ ``reduce`` | ``cat`` | ``list`` | ``catbuf`` | ``fallback``.
+    ``item_shape``/``item_size`` describe one *row* for cat-family leaves and
+    the full (rank-invariant) array for reduce leaves. ``cat_index`` is the
+    leaf's column in the header's length table (-1 for non-cat kinds).
+    """
+
+    __slots__ = ("name", "kind", "fx", "dtype", "item_shape", "item_size", "cat_index")
+
+    def __init__(self, name: str, kind: str, fx: Any, dtype: Any,
+                 item_shape: Tuple[int, ...], cat_index: int = -1) -> None:
+        self.name = name
+        self.kind = kind
+        self.fx = fx
+        self.dtype = dtype
+        self.item_shape = item_shape
+        self.item_size = int(np.prod(item_shape, dtype=np.int64)) if item_shape else 1
+        self.cat_index = cat_index
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"LeafSpec({self.name!r}, {self.kind}, fx={self.fx!r}, "
+                f"dtype={self.dtype}, item={self.item_shape})")
+
+
+class SyncPlan:
+    """The fused schedule for one schema: which leaves ride which collective.
+
+    ``n_collectives(world)`` is the payload-collective budget (header not
+    included): one per reduce bucket, one per non-empty cat bucket, plus the
+    per-leaf cost of unplannable fallbacks.
+    """
+
+    __slots__ = ("leaves", "cat_leaves", "reduce_buckets", "cat_buckets", "fallback", "schema_key")
+
+    def __init__(self, leaves: Dict[str, LeafSpec], cat_leaves: List[LeafSpec],
+                 reduce_buckets: Dict[Tuple[str, str], List[LeafSpec]],
+                 cat_buckets: Dict[str, List[LeafSpec]],
+                 fallback: List[LeafSpec], schema_key: str) -> None:
+        self.leaves = leaves
+        self.cat_leaves = cat_leaves
+        self.reduce_buckets = reduce_buckets
+        self.cat_buckets = cat_buckets
+        self.fallback = fallback
+        self.schema_key = schema_key
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.reduce_buckets) + len(self.cat_buckets)
+
+
+_PLAN_CACHE: Dict[str, SyncPlan] = {}
+_PLAN_LOCK = threading.Lock()
+_PLAN_CACHE_MAX = 256
+_plan_stats = {"hits": 0, "misses": 0}
+
+
+def clear_sync_plan_cache() -> None:
+    with _PLAN_LOCK:
+        _PLAN_CACHE.clear()
+        _plan_stats["hits"] = _plan_stats["misses"] = 0
+
+
+def sync_plan_cache_info() -> Dict[str, int]:
+    with _PLAN_LOCK:
+        return {"size": len(_PLAN_CACHE), **_plan_stats}
+
+
+def _classify(state: Dict[str, Any], reductions: Dict[str, Any], schema_key: str) -> SyncPlan:
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
+    cat_order = {n: j for j, n in enumerate(cat_family_names(state, reductions))}
+    leaves: Dict[str, LeafSpec] = {}
+    cat_leaves: List[LeafSpec] = []
+    reduce_buckets: Dict[Tuple[str, str], List[LeafSpec]] = {}
+    cat_buckets: Dict[str, List[LeafSpec]] = {}
+    fallback: List[LeafSpec] = []
+    for name in sorted(state):
+        v = state[name]
+        fx = reductions.get(name)
+        if isinstance(v, CatBuffer):
+            item = None if v.buffer is None else tuple(v.buffer.shape[1:])
+            dtype = None if v.buffer is None else v.buffer.dtype
+            spec = LeafSpec(name, "catbuf", fx, dtype, item or (), cat_order[name])
+        elif isinstance(v, (list, tuple)):
+            if len(v):
+                first = jnp.asarray(v[0])
+                item = tuple(first.shape[1:]) if first.ndim else ()
+                dtype = first.dtype
+            else:
+                item, dtype = (), None
+            spec = LeafSpec(name, "list", fx, dtype, item, cat_order[name])
+        else:
+            arr = jnp.asarray(v)
+            if fx in ("cat", None):
+                item = tuple(arr.shape[1:]) if arr.ndim else ()
+                spec = LeafSpec(name, "cat", fx, arr.dtype, item, cat_order[name])
+            elif fx in _REDUCERS:
+                spec = LeafSpec(name, "reduce", fx, arr.dtype, tuple(arr.shape))
+            else:
+                # callable fx: opaque reduction over the [world, ...] stack —
+                # cannot ride a shared buffer, so it keeps the per-leaf path
+                spec = LeafSpec(name, "fallback", fx, arr.dtype, tuple(arr.shape))
+        leaves[name] = spec
+        if spec.kind == "reduce":
+            reduce_buckets.setdefault((str(spec.dtype), spec.fx), []).append(spec)
+        elif spec.kind == "fallback":
+            fallback.append(spec)
+        else:
+            cat_leaves.append(spec)
+            if spec.dtype is not None:
+                cat_buckets.setdefault(str(spec.dtype), []).append(spec)
+            else:
+                # item spec unknown (empty list / unmaterialized CatBuffer):
+                # unreachable after a passed health check (count column == 0
+                # raises first); routed to the per-leaf path defensively
+                fallback.append(spec)
+    return SyncPlan(leaves, cat_leaves, reduce_buckets, cat_buckets, fallback, schema_key)
+
+
+def build_sync_plan(state: Dict[str, Any], reductions: Dict[str, Any]) -> SyncPlan:
+    """The (cached) fused schedule for this state schema.
+
+    Keyed on the exact schema string the health word hashes, so any change a
+    rank could legally make between syncs (a CatBuffer materializing its
+    item spec, a dtype cast) keys a fresh plan, while repeated syncs of the
+    same schema — every ``compute()`` of a long eval — hit the cache.
+    """
+    key = state_schema_parts(state, reductions)
+    with _PLAN_LOCK:
+        plan = _PLAN_CACHE.get(key)
+        if plan is not None:
+            _plan_stats["hits"] += 1
+            return plan
+    plan = _classify(state, reductions, key)
+    with _PLAN_LOCK:
+        _plan_stats["misses"] += 1
+        if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+            _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
+        _PLAN_CACHE[key] = plan
+    return plan
+
+
+def _local_flat_rows(value: Any, spec: LeafSpec):
+    """(rows, flat 1-D payload) of this rank's contribution to a cat leaf."""
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
+    if isinstance(value, CatBuffer):
+        rows = int(np.asarray(value.count))
+        return rows, value.values().reshape(-1)
+    if isinstance(value, (list, tuple)):
+        cat = jnp.concatenate([jnp.asarray(x)[None] if jnp.asarray(x).ndim == 0 else jnp.asarray(x) for x in value], axis=0)
+        return int(cat.shape[0]), cat.reshape(-1)
+    arr = jnp.asarray(value)
+    if arr.ndim == 0:
+        arr = arr[None]
+    return int(arr.shape[0]), arr.reshape(-1)
+
+
+def _assemble_cat(spec: LeafSpec, pieces: List[Any], local_value: Any, world: int) -> Any:
+    """Reconstruct one cat-family leaf from its per-rank row blocks —
+    byte-identical to what ``host_sync_leaf`` builds from its own gather."""
+    from metrics_tpu.core.cat_buffer import CatBuffer
+
+    if spec.kind == "catbuf":
+        merged = CatBuffer(world * local_value.capacity)
+        for p in pieces:
+            merged.append(p)
+        return merged
+    if spec.kind == "list":
+        return list(pieces)
+    return jnp.concatenate(pieces, axis=0)
+
+
+def host_sync_state_bucketed(
+    state: Dict[str, Any],
+    reductions: Dict[str, Any],
+    words: Optional[np.ndarray] = None,
+    timeout: Optional[float] = None,
+    plan: Optional[SyncPlan] = None,
+) -> Dict[str, Any]:
+    """Fused payload sync of a whole (possibly collection-combined) state.
+
+    Caller contract: the gathered health ``words`` have been *verified*
+    (``host_sync_state`` does this) — the plan assumes schema equality,
+    non-empty cat states and clean CatBuffers across ranks. Issues exactly
+    one ``process_allgather`` per reduce bucket and per cat bucket (plus the
+    per-leaf cost of callable-``fx`` fallbacks, and one length-vector gather
+    only when the schema outgrows the header's ``CAT_LENGTH_SLOTS``).
+    """
+    from metrics_tpu.parallel.sync import _process_allgather, host_sync_leaf
+
+    world = jax.process_count()
+    if plan is None:
+        plan = build_sync_plan(state, reductions)
+    out: Dict[str, Any] = {}
+
+    # ---- dynamic input: per-rank row counts for every cat-family leaf ----
+    n_cat = len(plan.cat_leaves)
+    lengths: Optional[np.ndarray] = None
+    if n_cat:
+        if words is not None:
+            lengths = header_cat_lengths(words, n_cat)
+        if lengths is None:
+            kinds = {"catbuf": "catbuf", "list": "list"}
+            local = np.asarray(
+                [cat_row_count(state[s.name], kinds.get(s.kind, "leaf")) for s in plan.cat_leaves],
+                np.int32,
+            )
+            lengths = np.asarray(_process_allgather(jnp.asarray(local), timeout=timeout))
+        lengths = np.asarray(lengths, dtype=np.int64)
+
+    # ---- reduce buckets: one collective per (dtype, fx) ------------------
+    for (_dtype, fx), specs in plan.reduce_buckets.items():
+        flat = jnp.concatenate([jnp.asarray(state[s.name]).reshape(-1) for s in specs])
+        if flat.size == 0:
+            for s in specs:
+                out[s.name] = jnp.asarray(state[s.name])
+            continue
+        gathered = _process_allgather(flat, timeout=timeout)  # [world, total]
+        reduced = _REDUCERS[fx](gathered)
+        off = 0
+        for s in specs:
+            out[s.name] = reduced[off : off + s.item_size].reshape(s.item_shape)
+            off += s.item_size
+
+    # ---- cat buckets: one padded ragged collective per dtype -------------
+    for _dtype, specs in plan.cat_buckets.items():
+        rows = lengths[:, [s.cat_index for s in specs]]  # [world, k]
+        elems = rows * np.asarray([s.item_size for s in specs], np.int64)
+        totals = elems.sum(axis=1)
+        max_total = int(totals.max()) if totals.size else 0
+        parts = []
+        for s in specs:
+            _n_rows, flat = _local_flat_rows(state[s.name], s)
+            # plan dtype = the schema hash's dtype rule (first element for
+            # lists). A heterogeneous list whose local concat promoted past
+            # it is cast back: the cross-rank collective must be well-formed
+            # and rank-symmetric, and the schema check only pins the
+            # first-element dtype (the per-leaf path has the same blind spot
+            # — it would feed dtype-divergent payloads straight into the
+            # gather). Homogeneous lists — the supported contract — no-op.
+            parts.append(flat if flat.dtype == s.dtype else flat.astype(s.dtype))
+        local_flat = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        if max_total == 0:
+            # nothing to move anywhere (every rank's rows are empty): skip the
+            # collective symmetrically (max_total is identical on all ranks)
+            gathered = jnp.zeros((world, 0), local_flat.dtype)
+        else:
+            padded = jnp.pad(local_flat, (0, max_total - int(local_flat.size)))
+            gathered = _process_allgather(padded, timeout=timeout)  # [world, max_total]
+        for j, s in enumerate(specs):
+            pieces = []
+            for r in range(world):
+                start = int(elems[r, :j].sum())
+                n = int(elems[r, j])
+                pieces.append(gathered[r, start : start + n].reshape((int(rows[r, j]),) + s.item_shape))
+            out[s.name] = _assemble_cat(s, pieces, state[s.name], world)
+
+    # ---- unplannable leaves: per-leaf path (prechecks already done) ------
+    for s in plan.fallback:
+        out[s.name] = host_sync_leaf(state[s.name], s.fx, precheck=False, timeout=timeout)
+
+    return out

@@ -12,7 +12,8 @@ payload gather, every rank contributes one small int32 *health word* per
 metric in a *single* ``process_allgather``::
 
     [version, schema_hash, update_count, overflow, nonfinite, n_states,
-     count_0 ... count_{COUNT_SLOTS-1}]
+     count_0 ... count_{COUNT_SLOTS-1},
+     len_0 ... len_{CAT_LENGTH_SLOTS-1}]
 
 - ``version``       protocol version (software-skew detection across ranks);
 - ``schema_hash``   CRC32 over the state schema (names, kinds, dtypes, item
@@ -31,6 +32,17 @@ metric in a *single* ``process_allgather``::
                     protocol), else array size. Unused slots hold ``-1``;
                     metrics with more than ``COUNT_SLOTS`` states fold the
                     tail's cat-family minimum into the last slot.
+- ``len_j``         this rank's *row count* for the j-th cat-family state
+                    (CatBuffer / list / array with ``fx`` in ``("cat",
+                    None)``, sorted by name among cat-family states only).
+                    The bucketed sync planner (``parallel/bucketing.py``)
+                    reads these columns to size its padded ragged payload
+                    buffers, folding what used to be one shape pre-gather
+                    *per uneven leaf* into this single header gather.
+                    Unused slots hold ``-1``; schemas with more than
+                    ``CAT_LENGTH_SLOTS`` cat-family states make the planner
+                    gather one dedicated length vector instead (still one
+                    collective, not one per leaf).
 
 The word has the SAME fixed width for *every* metric — not merely for every
 rank running the same metric — so the header gather itself is a well-formed
@@ -76,11 +88,18 @@ from metrics_tpu.utils.prints import rank_zero_warn
 __all__ = [
     "HEALTH_PROTOCOL_VERSION",
     "COUNT_SLOTS",
+    "CAT_LENGTH_SLOTS",
     "WORD_WIDTH",
     "NONFINITE_STATE",
+    "FUSED_KEY_SEP",
     "build_health_word",
+    "cat_family_names",
+    "cat_row_count",
+    "header_cat_lengths",
     "state_has_nonfinite",
     "state_poisoned",
+    "state_schema_hash",
+    "state_schema_parts",
     "verify_health_words",
     "call_with_sync_watchdog",
     "get_sync_timeout",
@@ -91,12 +110,22 @@ __all__ = [
 
 T = TypeVar("T")
 
-HEALTH_PROTOCOL_VERSION = 1
+#: v2: CAT_LENGTH_SLOTS per-leaf row-length columns appended to the word so
+#: the bucketed planner can size ragged payload buffers with zero extra
+#: shape gathers. v1 peers are caught by the width/version checks.
+HEALTH_PROTOCOL_VERSION = 2
 
 #: Reserved state name for the ``check_finite`` poison flag (see
 #: ``Metric.enable_check_finite``): an int32 scalar with ``dist_reduce_fx="sum"``
 #: so it propagates in-jit as one ``psum`` and on the host via the health word.
 NONFINITE_STATE = "_nonfinite"
+
+#: Separator used by ``MetricCollection``'s fused sync to combine member
+#: states into one dict (``<member key>\x1f<state name>``). Lives here
+#: because the health word must see THROUGH the prefixes: the poison
+#: verdict is computed per member group so a prefixed ``_nonfinite`` flag
+#: still gates its own member's states (and only them).
+FUSED_KEY_SEP = "\x1f"
 
 # health-word column layout (per-state participation counts follow the
 # fixed part; total width is constant across ALL metrics so the header
@@ -111,7 +140,12 @@ _F_FIXED = 6
 
 #: Fixed number of per-state count slots; unused slots hold the -1 sentinel.
 COUNT_SLOTS = 16
-WORD_WIDTH = _F_FIXED + COUNT_SLOTS
+_F_LENGTHS = _F_FIXED + COUNT_SLOTS
+
+#: Fixed number of per-cat-state row-length slots (bucketed-sync header);
+#: unused slots hold the -1 sentinel.
+CAT_LENGTH_SLOTS = 16
+WORD_WIDTH = _F_LENGTHS + CAT_LENGTH_SLOTS
 
 #: Watchdog default (seconds); env knob ``METRICS_TPU_SYNC_TIMEOUT_S``, 0 = off.
 DEFAULT_SYNC_TIMEOUT_S = 600.0
@@ -142,19 +176,17 @@ def _state_kinds(state: Dict[str, Any]):
     return names, kinds
 
 
-def state_schema_hash(state: Dict[str, Any], reductions: Dict[str, Any]) -> int:
-    """Stable 31-bit CRC over the metric's state *schema*.
+def state_schema_parts(state: Dict[str, Any], reductions: Dict[str, Any]) -> str:
+    """The canonical schema string the health word's CRC is computed over.
 
     Covers state names, kinds, dtypes, item shapes and declared reductions —
     everything that must agree across ranks for the payload gathers to be
     well-formed. Leading ("data") dims of cat-family states are excluded so
-    legitimately uneven per-rank batches hash equal; an empty list state
-    contributes only its name/kind (its dtype/item shape are unknown until
-    the first append, and emptiness is caught by the count columns *before*
-    the schema check so the hash never misattributes it).
+    legitimately uneven per-rank batches serialize equal. Also the cache key
+    of the bucketed sync planner (``parallel/bucketing.py``): keying on the
+    full string instead of the 31-bit CRC makes a hash collision harmless
+    (two colliding schemas could otherwise share a plan and corrupt a sync).
     """
-    import zlib
-
     from metrics_tpu.core.cat_buffer import CatBuffer
 
     parts = []
@@ -176,7 +208,62 @@ def state_schema_hash(state: Dict[str, Any], reductions: Dict[str, Any]) -> int:
             arr = jnp.asarray(v)
             shape = tuple(arr.shape[1:]) if fx in ("cat", None) else tuple(arr.shape)
             parts.append(f"{name}|leaf|{arr.dtype}{shape}|{fx_tag}")
-    return zlib.crc32(";".join(parts).encode()) & 0x7FFFFFFF
+    return ";".join(parts)
+
+
+def state_schema_hash(state: Dict[str, Any], reductions: Dict[str, Any]) -> int:
+    """Stable 31-bit CRC over :func:`state_schema_parts`.
+
+    An empty list state contributes only its name/kind (its dtype/item shape
+    are unknown until the first append, and emptiness is caught by the count
+    columns *before* the schema check so the hash never misattributes it).
+    """
+    import zlib
+
+    return zlib.crc32(state_schema_parts(state, reductions).encode()) & 0x7FFFFFFF
+
+
+def _is_cat_family(kind: str, fx: Any) -> bool:
+    """Does this state contribute a ragged row payload (vs a reduce/other)?
+
+    Mirrors ``host_sync_leaf``'s dispatch exactly: CatBuffer and list states
+    always gather rows regardless of ``fx``; array leaves gather rows only
+    for ``fx`` in ``("cat", None)`` (a callable ``fx`` stacks fixed shapes).
+    """
+    if kind in ("catbuf", "list"):
+        return True
+    return fx == "cat" or fx is None
+
+
+def cat_family_names(state: Dict[str, Any], reductions: Dict[str, Any]):
+    """Sorted names of the cat-family states — the order of the header's
+    ``len_j`` columns AND of the bucketed planner's ragged-leaf table."""
+    names, kinds = _state_kinds(state)
+    return [n for n in names if _is_cat_family(kinds[n], reductions.get(n))]
+
+
+def cat_row_count(value: Any, kind: str) -> int:
+    """Rows this rank contributes to a cat-family state's gathered payload.
+
+    CatBuffer: fill count. List: total rows across appended batches (scalar
+    entries promote to one row, matching ``host_sync_leaf``'s local concat).
+    Array leaf: leading dim (a scalar promotes to one row).
+    """
+    if kind == "catbuf":
+        return int(np.asarray(value.count))
+    if kind == "list":
+        return int(sum(1 if jnp.asarray(v).ndim == 0 else jnp.asarray(v).shape[0] for v in value))
+    arr = jnp.asarray(value)
+    return 1 if arr.ndim == 0 else int(arr.shape[0])
+
+
+def header_cat_lengths(words: np.ndarray, n_cat: int) -> Optional[np.ndarray]:
+    """Per-rank row counts ``[world, n_cat]`` from the header's length
+    columns, or ``None`` when the schema has more cat-family states than
+    ``CAT_LENGTH_SLOTS`` (the planner then gathers one length vector)."""
+    if n_cat > CAT_LENGTH_SLOTS:
+        return None
+    return np.asarray(words)[:, _F_LENGTHS : _F_LENGTHS + n_cat]
 
 
 def _element_count(value: Any, kind: str) -> int:
@@ -227,11 +314,24 @@ def state_poisoned(state: Dict[str, Any]) -> bool:
     the latched per-update flag OR the whole-state scan (the per-update
     screen skips CatBuffer bodies for cost; the scan here makes the verdict
     exact). ``False`` when screening never registered the flag state.
-    Host-path only — callers guard against traced flags."""
-    flag = state.get(NONFINITE_STATE)
-    if flag is None:
-        return False
-    return int(np.asarray(flag)) > 0 or state_has_nonfinite(state)
+    Host-path only — callers guard against traced flags.
+
+    Understands collection-combined states (``<member>\\x1f<name>`` keys,
+    :data:`FUSED_KEY_SEP`): the verdict is computed per member group, so a
+    member's poison flag gates that member's own states — a member that
+    never opted into ``check_finite`` is not screened, exactly as in the
+    per-member sync loop."""
+    groups: Dict[str, Dict[str, Any]] = {}
+    for name, value in state.items():
+        prefix, _, leaf = name.rpartition(FUSED_KEY_SEP)
+        groups.setdefault(prefix, {})[leaf] = value
+    for group in groups.values():
+        flag = group.get(NONFINITE_STATE)
+        if flag is None:
+            continue
+        if int(np.asarray(flag)) > 0 or state_has_nonfinite(group):
+            return True
+    return False
 
 
 def build_health_word(
@@ -248,9 +348,10 @@ def build_health_word(
     for name in names:
         if kinds[name] == "catbuf" and bool(np.asarray(state[name].overflowed)):
             overflow = 1
-    nonfinite = 0
-    if kinds.get(NONFINITE_STATE) == "leaf":
-        nonfinite = int(state_poisoned(state))
+    # state_poisoned returns False when no (member's) flag state exists and
+    # sees through collection-fused key prefixes, so one call covers plain
+    # metrics and combined collection states alike
+    nonfinite = int(state_poisoned(state))
     counts = [_element_count(state[name], kinds[name]) for name in names]
     slots = [-1] * COUNT_SLOTS
     if len(counts) <= COUNT_SLOTS:
@@ -265,6 +366,10 @@ def build_health_word(
             if kinds[name] in ("catbuf", "list")
         ]
         slots[COUNT_SLOTS - 1] = min(tail_cat) if tail_cat else -1
+    length_slots = [-1] * CAT_LENGTH_SLOTS
+    cat_names = [n for n in names if _is_cat_family(kinds[n], reductions.get(n))]
+    for j, name in enumerate(cat_names[:CAT_LENGTH_SLOTS]):
+        length_slots[j] = cat_row_count(state[name], kinds[name])
     word = [
         HEALTH_PROTOCOL_VERSION,
         state_schema_hash(state, reductions),
@@ -272,7 +377,7 @@ def build_health_word(
         overflow,
         nonfinite,
         len(names),
-    ] + slots
+    ] + slots + length_slots
     return np.asarray(word, dtype=np.int32)
 
 

@@ -13,6 +13,11 @@ machinery (``metric.py:217-242``). Two paths:
   eager ``compute()``-time gather. Uneven leading dims are handled with the
   gather-sizes → pad-to-max → gather → trim protocol (reference
   ``distributed.py:122-145``) because XLA collectives need static shapes.
+  After the health header verifies, the payload defaults to the **bucketed
+  fused path** (``parallel/bucketing.py``): one collective per dtype/fx
+  class for the whole state (or a whole ``MetricCollection``), with per-rank
+  lengths riding the header instead of per-leaf shape gathers
+  (``METRICS_TPU_FUSED_SYNC=0`` restores the per-leaf path).
 """
 from typing import Any, Callable, Dict, List, Optional, Union
 
@@ -89,17 +94,34 @@ def sync_leaf_in_jit(value: Array, fx: ReduceFx, axis_name: str) -> Array:
 
 
 def sync_in_jit(
-    state: Dict[str, Any], reductions: Dict[str, ReduceFx], axis_name: str
+    state: Dict[str, Any],
+    reductions: Dict[str, ReduceFx],
+    axis_name: str,
+    fused: bool = False,
 ) -> Dict[str, Any]:
     """Synchronize a whole metric-state dict over ``axis_name`` inside jit.
 
     List-valued ("cat") states are concatenated locally first so each state
     costs exactly one collective — the fused analogue of reference
     ``metric.py:220-223`` (pre-concatenate to reduce the number of gathers).
+    A callable ``fx`` on a list state is honored (applied to the local
+    concat with the in-jit ``fx(value, axis_name)`` convention, same as
+    array leaves) instead of the historical unconditional ``"cat"``. Note
+    the host path's convention differs: ``host_sync_leaf`` gathers list
+    states regardless of ``fx``, and its callable convention is the
+    single-argument ``fx(gathered)``.
+
+    ``fused=True`` additionally buckets the reduce-style array leaves
+    (``sum``/``mean``/``max``/``min``) by ``(dtype, fx)`` and concatenates
+    each bucket into ONE flat ``psum``/``pmean``/``pmax``/``pmin``, so a
+    shard_map program emits O(#dtypes × #fx-classes) collective ops for XLA
+    to schedule instead of one per leaf — elementwise over the same mesh
+    axis, so results are identical to the per-leaf collectives.
     """
     from metrics_tpu.core.cat_buffer import CatBuffer, sync_cat_buffer_in_jit
 
     out: Dict[str, Any] = {}
+    buckets: Dict[Any, list] = {}
     for name, value in state.items():
         fx = reductions.get(name)
         if isinstance(value, CatBuffer):
@@ -109,9 +131,26 @@ def sync_in_jit(
                 out[name] = value
                 continue
             value = jnp.concatenate([v[None] if v.ndim == 0 else v for v in value], axis=0)
-            out[name] = [sync_leaf_in_jit(value, "cat", axis_name)]
+            if callable(fx):
+                out[name] = [fx(value, axis_name)]
+            else:
+                out[name] = [sync_leaf_in_jit(value, "cat", axis_name)]
+        elif fused and fx in ("sum", "mean", "max", "min"):
+            arr = jnp.asarray(value)
+            buckets.setdefault((str(arr.dtype), fx), []).append((name, arr))
         else:
             out[name] = sync_leaf_in_jit(value, fx, axis_name)
+    for (_dtype, fx), leaves in buckets.items():
+        if len(leaves) == 1:
+            name, arr = leaves[0]
+            out[name] = sync_leaf_in_jit(arr, fx, axis_name)
+            continue
+        flat = jnp.concatenate([arr.reshape(-1) for _, arr in leaves])
+        reduced = sync_leaf_in_jit(flat, fx, axis_name)
+        offset = 0
+        for name, arr in leaves:
+            out[name] = reduced[offset : offset + arr.size].reshape(arr.shape)
+            offset += arr.size
     return out
 
 
@@ -144,20 +183,36 @@ def _process_allgather(x: Array, timeout: Optional[float] = None) -> Array:
 
 
 def gather_all_arrays(
-    result: Array, group: Optional[Any] = None, timeout: Optional[float] = None
+    result: Array,
+    group: Optional[Any] = None,
+    timeout: Optional[float] = None,
+    all_shapes: Optional[Any] = None,
 ) -> List[Array]:
     """Gather one array from every process; supports uneven leading dims.
 
     Behavioral analogue of reference ``gather_all_tensors``
     (``utilities/distributed.py:96-145``): returns a list with one entry per
     process, trimmed back to each process's true shape.
+
+    ``all_shapes`` (``[world, ndim]``) lets a caller that already knows
+    every rank's shape — the bucketed planner supplies them from the sync
+    header, and reduce-style leaves have schema-verified static shapes —
+    skip the shape pre-gather entirely, saving one collective per call.
     """
     result = jnp.asarray(result)
     world = jax.process_count()
     if world == 1:
         return [result]
-    local_shape = jnp.asarray(result.shape, dtype=jnp.int32)
-    all_shapes = np.asarray(_process_allgather(local_shape, timeout=timeout))  # [world, ndim]
+    if all_shapes is None:
+        local_shape = jnp.asarray(result.shape, dtype=jnp.int32)
+        all_shapes = np.asarray(_process_allgather(local_shape, timeout=timeout))  # [world, ndim]
+    else:
+        all_shapes = np.asarray(all_shapes, dtype=np.int32)
+        if all_shapes.shape != (world, result.ndim):
+            raise ValueError(
+                f"gather_all_arrays: all_shapes must be [world={world}, "
+                f"ndim={result.ndim}], got {all_shapes.shape}"
+            )
     max_shape = all_shapes.max(axis=0)
     if (all_shapes == all_shapes[0]).all():
         gathered = _process_allgather(result, timeout=timeout)  # [world, ...]
@@ -243,7 +298,14 @@ def host_sync_leaf(
         return list(gather_all_arrays(vals[0], timeout=timeout))
     if not jit_distributed_available():
         return value
-    pieces = gather_all_arrays(jnp.asarray(value), timeout=timeout)
+    value = jnp.asarray(value)
+    known_shapes = None
+    if not precheck and fx not in ("cat", None):
+        # the caller verified the sync header, whose schema hash covers the
+        # FULL shape of reduce/callable-fx leaves — every rank's shape is
+        # known-equal, so the shape pre-gather would be a redundant collective
+        known_shapes = np.tile(np.asarray(value.shape, np.int32), (jax.process_count(), 1))
+    pieces = gather_all_arrays(value, timeout=timeout, all_shapes=known_shapes)
     if fx == "cat" or fx is None:
         return jnp.concatenate([p[None] if p.ndim == 0 else p for p in pieces], axis=0)
     gathered = jnp.stack(pieces, axis=0)
@@ -268,6 +330,7 @@ def host_sync_state(
     strict_update_count: bool = False,
     timeout: Optional[float] = None,
     metric_name: str = "metric",
+    fused: Optional[bool] = None,
 ) -> Dict[str, Any]:
     """Host-path sync of a whole metric-state dict across processes.
 
@@ -279,6 +342,16 @@ def host_sync_state(
     rank *before* any payload gather, and the per-leaf count/flag
     prechecks are skipped as redundant — one collective where the leaf
     loop used to issue up to two per state.
+
+    After a verified header the *payload* defaults to the **bucketed fused
+    path** (``parallel/bucketing.py``): reduce leaves grouped by
+    ``(dtype, fx)`` into flat buffers, cat-family leaves by dtype into one
+    padded ragged buffer sized from the header's length columns — the whole
+    state syncs in O(#dtypes × #fx-classes) collectives instead of one or
+    more per leaf, bit-identical to the per-leaf path. ``fused=None`` reads
+    the ``METRICS_TPU_FUSED_SYNC`` env knob (default on; ``0`` is the
+    escape hatch); ``check_health=False`` always uses the per-leaf path
+    (the planner requires a verified header).
 
     Once a watchdog has fired anywhere in the process, the cross-process
     channel is *suspect* (the abandoned worker may still sit inside the
@@ -317,6 +390,12 @@ def host_sync_state(
             metric_name=metric_name,
         )
         precheck = False
+        from metrics_tpu.parallel.bucketing import fused_sync_enabled, host_sync_state_bucketed
+
+        if fused is None:
+            fused = fused_sync_enabled()
+        if fused:
+            return host_sync_state_bucketed(state, reductions, words=words, timeout=timeout)
     return {
         name: host_sync_leaf(value, reductions.get(name), precheck=precheck, timeout=timeout)
         for name, value in state.items()

@@ -15,6 +15,11 @@ Usage::
 
 Call it before the first jit compilation. No-op (with a warning) if jax is
 too old to support the config knobs.
+
+The ``METRICS_TPU_COMPILE_CACHE`` env var switches the cache on without code
+changes (:func:`enable_from_env` — the dryrun driver and bench honor it):
+``1``/``true``/``on`` uses the default dir, any other non-off value is taken
+as the cache directory, and ``0``/``false``/``off``/unset leaves it alone.
 """
 import os
 from typing import Optional
@@ -24,6 +29,9 @@ from metrics_tpu.utils.prints import rank_zero_warn
 DEFAULT_DIR = os.path.join(
     os.environ.get("XDG_CACHE_HOME", os.path.expanduser("~/.cache")), "metrics_tpu", "xla"
 )
+
+#: Env knob read by :func:`enable_from_env`.
+ENV_VAR = "METRICS_TPU_COMPILE_CACHE"
 
 
 def enable(cache_dir: Optional[str] = None, min_compile_seconds: float = 1.0) -> str:
@@ -45,3 +53,25 @@ def enable(cache_dir: Optional[str] = None, min_compile_seconds: float = 1.0) ->
     except AttributeError as err:  # pragma: no cover - jax without the knobs
         rank_zero_warn(f"persistent compilation cache unavailable in this jax: {err}")
     return path
+
+
+def enable_from_env(min_compile_seconds: float = 1.0) -> Optional[str]:
+    """Enable the cache iff ``METRICS_TPU_COMPILE_CACHE`` asks for it.
+
+    Returns the cache dir when enabled, ``None`` when the knob is unset or
+    off. Never raises — an operator convenience knob must not take down the
+    job it was meant to speed up (failures warn and return ``None``).
+    """
+    val = os.environ.get(ENV_VAR)
+    if val is None:
+        return None
+    v = val.strip()
+    if v.lower() in ("", "0", "false", "off", "no"):
+        return None
+    try:
+        if v.lower() in ("1", "true", "on", "yes"):
+            return enable(min_compile_seconds=min_compile_seconds)
+        return enable(v, min_compile_seconds=min_compile_seconds)
+    except Exception as err:  # noqa: BLE001 - the knob is best-effort
+        rank_zero_warn(f"{ENV_VAR}={val!r}: could not enable compile cache: {err}")
+        return None

@@ -1,0 +1,82 @@
+"""Lockstep multi-rank collective simulator for host-sync equivalence tests.
+
+``EchoAllgather`` (tests/parallel/test_fault_injection.py) fakes a world
+where every peer contributes *this* rank's value — enough for divergence
+injection, but it cannot express genuinely uneven per-rank states. This
+module runs the REAL sync code for every rank concurrently (one thread per
+rank) and turns each ``_raw_process_allgather`` call into a barrier
+rendezvous that stacks what every rank actually contributed — a faithful
+single-process model of the multi-process collective, so bucketed-vs-
+per-leaf results can be compared bit-for-bit over mixed-dtype, uneven
+states.
+
+Collectives must be issued with the watchdog disabled (``timeout=0`` →
+inline execution): the watchdog's worker thread would lose the rank's
+thread-local identity.
+"""
+import threading
+from typing import Any, Callable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["LockstepWorld"]
+
+
+class LockstepWorld:
+    """Run ``fn(rank)`` on ``world`` threads with rendezvous collectives.
+
+    Install with::
+
+        monkeypatch.setattr(jax, "process_count", lambda: w.world)
+        monkeypatch.setattr(sync_mod, "_raw_process_allgather", w.allgather)
+
+    ``calls`` counts collective *rounds* (one per rendezvous, not per rank).
+    A rank that raises aborts the barrier so peers fail fast instead of
+    deadlocking; the first rank's exception is re-raised from :meth:`run`.
+    """
+
+    def __init__(self, world: int = 2) -> None:
+        self.world = world
+        self.calls = 0
+        self._barrier = threading.Barrier(world)
+        self._slots: List[Optional[np.ndarray]] = [None] * world
+        self._rank = threading.local()
+
+    def allgather(self, x: Any):
+        rank = self._rank.value
+        self._slots[rank] = np.asarray(x).copy()
+        if self._barrier.wait() == 0:
+            self.calls += 1
+        out = jnp.asarray(np.stack(self._slots))
+        # second rendezvous: every rank reads before the next round overwrites
+        self._barrier.wait()
+        return out
+
+    def run(self, fn: Callable[[int], Any], timeout: float = 120.0) -> List[Any]:
+        results: List[Any] = [None] * self.world
+        errors: List[Optional[BaseException]] = [None] * self.world
+
+        def body(rank: int) -> None:
+            self._rank.value = rank
+            try:
+                results[rank] = fn(rank)
+            except BaseException as err:  # noqa: BLE001 - re-raised below
+                errors[rank] = err
+                self._barrier.abort()
+
+        threads = [
+            threading.Thread(target=body, args=(r,), daemon=True, name=f"lockstep-rank{r}")
+            for r in range(self.world)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout)
+        if any(t.is_alive() for t in threads):
+            self._barrier.abort()
+            raise RuntimeError("LockstepWorld deadlocked: a rank never reached the barrier")
+        for err in errors:
+            if err is not None:
+                raise err
+        return results

@@ -1,0 +1,578 @@
+"""Bucketed (fused) host-sync equivalence suite (ISSUE 2 tentpole).
+
+The contract under test: the bucketed planner (``parallel/bucketing.py``)
+syncs a whole state dict — or a whole ``MetricCollection`` — in
+O(#dtypes × #fx-classes) ``_raw_process_allgather`` calls and produces
+**bit-identical** results to the per-leaf path, across mixed dtypes, mixed
+reductions, uneven cat lengths, list states, CatBuffers and callable-``fx``
+fallbacks. Real two-rank payloads run through :class:`LockstepWorld`
+(``tests/helpers/fake_world.py``): every rank executes the production sync
+code on its own thread and each collective is a barrier rendezvous over the
+ranks' actual contributions.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.parallel.sync as sync_mod
+from metrics_tpu.core.cat_buffer import CatBuffer
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel.bucketing import (
+    build_sync_plan,
+    clear_sync_plan_cache,
+    fused_sync_enabled,
+    sync_plan_cache_info,
+)
+from metrics_tpu.parallel.health import CAT_LENGTH_SLOTS, build_health_word, header_cat_lengths
+from metrics_tpu.parallel.sync import gather_all_arrays, host_sync_state, sync_in_jit
+from metrics_tpu.utils.exceptions import SyncError
+from tests.helpers.fake_world import LockstepWorld
+
+WORLD = 2
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_cache():
+    clear_sync_plan_cache()
+    yield
+    clear_sync_plan_cache()
+
+
+@pytest.fixture
+def lockstep(monkeypatch):
+    """A real two-rank world: production sync code per rank, rendezvous
+    collectives, ``calls`` counting collective rounds."""
+    world = LockstepWorld(WORLD)
+    monkeypatch.setattr(jax, "process_count", lambda: world.world)
+    monkeypatch.setattr(sync_mod, "_raw_process_allgather", world.allgather)
+    return world
+
+
+def _custom_fx(gathered):
+    return jnp.sum(gathered, axis=0) * 2.0
+
+
+def _mixed_state(rank: int):
+    """Uneven, mixed-dtype, mixed-fx state — every leaf family at once."""
+    buf = CatBuffer(16)
+    buf.append(jnp.arange(3 + 2 * rank, dtype=jnp.float32) + 100.0 * rank)
+    ibuf = CatBuffer(8)
+    ibuf.append(jnp.asarray([[1 + rank, 2], [3, 4 + rank]], jnp.int32)[: 1 + rank])
+    state = {
+        "sum_f32": jnp.asarray([[1.5, 2.5], [3.5, 4.5]]) * (rank + 1),
+        "sum_scalar": jnp.asarray(2.0 + rank),
+        "sum_i32": jnp.asarray([2, 3], jnp.int32) + rank,
+        "mean_f32": jnp.asarray([0.25, 0.75]) + rank,
+        "max_f32": jnp.asarray([[1.0 + 3 * rank, 2.0], [5.0, 4.0 - rank]]),
+        "min_i32": jnp.asarray(5 - 2 * rank, jnp.int32),
+        "cat_f32": jnp.arange(3 + rank, dtype=jnp.float32) + 10.0 * rank,  # uneven rows
+        "cat_2d": (jnp.arange(2 * (2 - rank), dtype=jnp.float32).reshape(2 - rank, 2) - rank),
+        "cat_i32": jnp.arange(4 - rank, dtype=jnp.int32) + 20 * rank,
+        "none_scalar": jnp.asarray(7.0 + rank),  # fx=None → cat family
+        "lst": [jnp.asarray([1.0, 2.0]) + rank, jnp.asarray(3.0 + rank)],
+        "buf": buf,  # uneven CatBuffer fill
+        "ibuf": ibuf,  # int CatBuffer, uneven rows
+        "cust": jnp.asarray([1.0 + rank, 2.0]),  # callable fx → fallback
+    }
+    reductions = {
+        "sum_f32": "sum", "sum_scalar": "sum", "sum_i32": "sum",
+        "mean_f32": "mean", "max_f32": "max", "min_i32": "min",
+        "cat_f32": "cat", "cat_2d": "cat", "cat_i32": "cat",
+        "none_scalar": None, "lst": "cat", "buf": "cat", "ibuf": "cat",
+        "cust": _custom_fx,
+    }
+    return state, reductions
+
+
+def _assert_leaf_equal(a, b, name):
+    """Bit-for-bit: same type, dtype, shape, bytes."""
+    if isinstance(a, CatBuffer):
+        assert isinstance(b, CatBuffer), name
+        assert a.capacity == b.capacity, name
+        assert int(np.asarray(a.count)) == int(np.asarray(b.count)), name
+        assert bool(np.asarray(a.overflowed)) == bool(np.asarray(b.overflowed)), name
+        assert np.asarray(a.buffer).tobytes() == np.asarray(b.buffer).tobytes(), name
+    elif isinstance(a, list):
+        assert isinstance(b, list) and len(a) == len(b), name
+        for x, y in zip(a, b):
+            _assert_leaf_equal(x, y, name)
+    else:
+        a, b = np.asarray(a), np.asarray(b)
+        assert a.dtype == b.dtype, (name, a.dtype, b.dtype)
+        assert a.shape == b.shape, (name, a.shape, b.shape)
+        assert a.tobytes() == b.tobytes(), name
+
+
+def _assert_state_equal(sa, sb):
+    assert sorted(sa) == sorted(sb)
+    for name in sa:
+        _assert_leaf_equal(sa[name], sb[name], name)
+
+
+def _run_sync(world, fused, state_fn=_mixed_state):
+    def body(rank):
+        state, reds = state_fn(rank)
+        # timeout=0: watchdog inline, so the rank's thread-local survives
+        return host_sync_state(state, reds, update_count=3, timeout=0, fused=fused)
+
+    return world.run(body)
+
+
+# ---------------------------------------------------------------------------
+# bit-for-bit equivalence over genuinely uneven two-rank states
+# ---------------------------------------------------------------------------
+
+def test_fused_equals_per_leaf_bit_for_bit(lockstep):
+    fused = _run_sync(lockstep, fused=True)
+    per_leaf = _run_sync(lockstep, fused=False)
+    for rank in range(WORLD):
+        _assert_state_equal(fused[rank], per_leaf[rank])
+    # collectives are symmetric: every rank computes the identical result
+    _assert_state_equal(fused[0], fused[1])
+
+
+def test_fused_merges_uneven_cat_rows_correctly(lockstep):
+    out = _run_sync(lockstep, fused=True)[0]
+    # cat_f32: rank0 has 3 rows, rank1 has 4 — concatenated in rank order
+    expected = np.concatenate([np.arange(3, dtype=np.float32), np.arange(4, dtype=np.float32) + 10.0])
+    np.testing.assert_array_equal(np.asarray(out["cat_f32"]), expected)
+    # CatBuffer: 3 + 5 uneven rows, world*capacity merged buffer
+    assert len(out["buf"]) == 3 + 5 and out["buf"].capacity == WORLD * 16
+    np.testing.assert_array_equal(
+        np.asarray(out["buf"].values()),
+        np.concatenate([np.arange(3, dtype=np.float32), np.arange(5, dtype=np.float32) + 100.0]),
+    )
+    # list state: one trimmed piece per rank
+    assert len(out["lst"]) == WORLD and out["lst"][0].shape == (3,)
+    # callable fx fallback still honored
+    np.testing.assert_array_equal(np.asarray(out["cust"]), np.asarray([1.0 + 2.0, 4.0]) * 2.0)
+
+
+def test_fused_collective_budget(lockstep):
+    _run_sync(lockstep, fused=True)
+    fused_calls = lockstep.calls
+    lockstep.calls = 0
+    _run_sync(lockstep, fused=False)
+    per_leaf_calls = lockstep.calls
+
+    state, reds = _mixed_state(0)
+    plan = build_sync_plan(state, reds)
+    # 1 header + one collective per bucket + the callable fallback's payload
+    # (its shape gather is skipped: schema-verified static shape)
+    assert fused_calls == 1 + plan.n_buckets + len(plan.fallback)
+    assert fused_calls < len(state), (fused_calls, len(state))
+    assert fused_calls < per_leaf_calls, (fused_calls, per_leaf_calls)
+
+
+def test_header_carries_cat_lengths(lockstep):
+    state, reds = _mixed_state(1)
+    word = build_health_word(state, reds)
+    plan = build_sync_plan(state, reds)
+    lengths = header_cat_lengths(np.stack([word, word]), len(plan.cat_leaves))
+    # header column order == planner cat-leaf order; values are row counts
+    for j, spec in enumerate(plan.cat_leaves):
+        from metrics_tpu.parallel.health import _state_kinds, cat_row_count
+
+        _, kinds = _state_kinds(state)
+        assert lengths[0, j] == cat_row_count(state[spec.name], kinds[spec.name]), spec.name
+
+
+def test_fused_beyond_length_slots_gathers_one_length_vector(lockstep):
+    """> CAT_LENGTH_SLOTS cat states: one extra length-vector collective,
+    still O(#buckets) overall and bit-identical to per-leaf."""
+    n = CAT_LENGTH_SLOTS + 2
+
+    def big_state(rank):
+        state = {f"c{j:02d}": jnp.arange(j % 3 + 1 + rank, dtype=jnp.float32) + j for j in range(n)}
+        reds = {k: "cat" for k in state}
+        return state, reds
+
+    fused = _run_sync(lockstep, fused=True, state_fn=big_state)
+    fused_calls = lockstep.calls
+    lockstep.calls = 0
+    per_leaf = _run_sync(lockstep, fused=False, state_fn=big_state)
+    _assert_state_equal(fused[0], per_leaf[0])
+    # 1 header + 1 length vector + 1 f32 cat bucket
+    assert fused_calls == 3
+
+
+def test_plan_cache_hits_on_same_schema(lockstep):
+    state0, reds = _mixed_state(0)
+    plan_a = build_sync_plan(state0, reds)
+    # same schema, different data (uneven leading dims hash equal)
+    state1, _ = _mixed_state(1)
+    plan_b = build_sync_plan(state1, reds)
+    assert plan_a is plan_b
+    info = sync_plan_cache_info()
+    assert info["size"] == 1 and info["hits"] == 1 and info["misses"] == 1
+    # a schema change (dtype) misses
+    changed = dict(state0)
+    changed["sum_scalar"] = jnp.asarray(2, jnp.int32)
+    assert build_sync_plan(changed, reds) is not plan_a
+    assert sync_plan_cache_info()["misses"] == 2
+
+
+def test_repeated_syncs_replan_zero_times(lockstep):
+    _run_sync(lockstep, fused=True)
+    misses = sync_plan_cache_info()["misses"]
+    _run_sync(lockstep, fused=True)
+    _run_sync(lockstep, fused=True)
+    assert sync_plan_cache_info()["misses"] == misses  # plan reused, 0 replans
+
+
+# ---------------------------------------------------------------------------
+# escape hatches
+# ---------------------------------------------------------------------------
+
+def test_env_escape_hatch(monkeypatch):
+    assert fused_sync_enabled()  # default on
+    monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "0")
+    assert not fused_sync_enabled()
+    monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "off")
+    assert not fused_sync_enabled()
+    monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "1")
+    assert fused_sync_enabled()
+
+
+def test_env_escape_hatch_routes_per_leaf(lockstep, monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "0")
+    _run_sync(lockstep, fused=None)  # env decides
+    env_calls = lockstep.calls
+    monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "1")
+    lockstep.calls = 0
+    _run_sync(lockstep, fused=None)
+    assert lockstep.calls < env_calls  # fused default issues fewer collectives
+
+
+# ---------------------------------------------------------------------------
+# MetricCollection fused path
+# ---------------------------------------------------------------------------
+
+class _SumMetric(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + jnp.asarray(jnp.size(x), jnp.int32)
+
+    def compute(self):
+        return self.total / self.count
+
+
+class _MaxMetric(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("mx", jnp.full((2,), -jnp.inf), dist_reduce_fx="max")
+
+    def update(self, x):
+        self.mx = jnp.maximum(self.mx, x)
+
+    def compute(self):
+        return self.mx
+
+
+class _CatMetric(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("vals", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals.append(x)
+
+    def compute(self):
+        return jnp.concatenate([v[None] if v.ndim == 0 else v for v in self.vals])
+
+
+def _make_collection(rank):
+    mc = MetricCollection({"avg": _SumMetric(), "mx": _MaxMetric(), "cat": _CatMetric()})
+    for m in mc.values():
+        m.distributed_available_fn = lambda: True
+    mc["avg"].update(jnp.asarray([1.0 + rank, 2.0]))
+    mc["mx"].update(jnp.asarray([3.0 + rank, 1.0 - rank]))
+    mc["cat"].update(jnp.arange(2 + rank, dtype=jnp.float32) + 5.0 * rank)
+    return mc
+
+
+def test_collection_fused_sync_one_plan(lockstep):
+    """≥3 metrics, ≥6 leaves: the whole collection syncs in ≤ 1 header +
+    #dtypes·#fx-classes collectives with per-member-identical results."""
+
+    def body(rank):
+        mc = _make_collection(rank)
+        mc.sync(timeout=0)
+        synced = {
+            "avg_total": np.asarray(mc["avg"].total).copy(),
+            "avg_count": np.asarray(mc["avg"].count).copy(),
+            "mx": np.asarray(mc["mx"].mx).copy(),
+            "cat": [np.asarray(v).copy() for v in mc["cat"].vals],
+            "synced": [m._is_synced for m in mc.values()],
+        }
+        mc.unsync()
+        synced["local_total"] = float(np.asarray(mc["avg"].total))
+        return synced
+
+    r0, r1 = lockstep.run(body)
+    # buckets for 3 metrics / 4 leaves: (f32,sum), (i32,sum), (f32,max), f32-cat
+    assert lockstep.calls == 1 + 4, lockstep.calls
+    n_leaves = 4
+    assert lockstep.calls <= 1 + 4 and lockstep.calls > 0
+    assert lockstep.calls < 1 + n_leaves + 1  # strictly better than ≥1/leaf
+    assert all(r0["synced"]) and all(r1["synced"])
+    np.testing.assert_allclose(r0["avg_total"], (1.0 + 2.0) + (2.0 + 2.0))
+    assert int(r0["avg_count"]) == 4
+    np.testing.assert_array_equal(r0["mx"], [4.0, 1.0])
+    assert len(r0["cat"]) == WORLD  # one gathered piece per rank
+    np.testing.assert_array_equal(r0["cat"][1], [5.0, 6.0, 7.0])
+    # unsync restored rank-local state
+    assert r0["local_total"] == 3.0 and r1["local_total"] == 4.0
+    # symmetric across ranks
+    np.testing.assert_array_equal(r0["mx"], r1["mx"])
+
+
+def test_collection_fused_matches_per_member(lockstep, monkeypatch):
+    def run(env_value):
+        monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", env_value)
+
+        def body(rank):
+            mc = _make_collection(rank)
+            mc.sync(timeout=0)
+            return {k: {n: v for n, v in m._state.items()} for k, m in mc.items()}
+
+        return lockstep.run(body)
+
+    fused = run("1")
+    per_member = run("0")
+    for rank in range(WORLD):
+        for key in fused[rank]:
+            _assert_state_equal(fused[rank][key], per_member[rank][key])
+
+
+def test_collection_member_optout_disables_fused(lockstep):
+    def body(rank):
+        mc = _make_collection(rank)
+        mc["avg"].sync_fused = False  # one member opts out → whole collection per-member
+        mc.sync(timeout=0)
+        return float(np.asarray(mc["avg"].total))
+
+    totals = lockstep.run(body)
+    # per-member loop: 3 headers + payloads > the fused path's 5 rounds
+    assert lockstep.calls > 5
+    assert totals[0] == totals[1] == 7.0
+
+
+def test_collection_strict_member_keeps_per_member_semantics(lockstep):
+    """A strict member must NOT ride the fused header: its summed
+    update-count column would escalate strictness onto non-strict members
+    with legitimately ragged counts (and opposite skews could cancel)."""
+
+    def body(rank):
+        mc = _make_collection(rank)
+        mc["avg"].sync_strict_update_count = True
+        # ragged but legal: the non-strict members saw one extra batch on
+        # rank 1 — per-member semantics warn, they must not raise
+        mc["mx"]._update_count = 2 + rank
+        mc["cat"]._update_count = 2 + rank
+        mc.sync(timeout=0)
+        return [m._is_synced for m in mc.values()]
+
+    # the warning fires in worker threads; the filter/record machinery is
+    # process-global, so one outer recorder sees it (pytest.warns inside
+    # each thread would race on the global filter state)
+    with pytest.warns(RuntimeWarning, match="update-count skew"):
+        results = lockstep.run(body)
+    for synced in results:
+        assert all(synced)
+    # per-member loop ran (3 headers), not the fused single-header path
+    assert lockstep.calls > 5
+
+
+def test_collection_fused_failure_all_or_nothing(lockstep):
+    """A sick member (empty cat state) fails the fused header: under
+    on_error='raise' NO member is left synced or mutated."""
+
+    def body(rank):
+        mc = MetricCollection({"good": _SumMetric(), "bad": _CatMetric()})
+        for m in mc.values():
+            m.distributed_available_fn = lambda: True
+        mc["good"].update(jnp.asarray(1.0 + rank))
+        try:
+            mc.sync(timeout=0)
+            raise AssertionError("sync should have raised")
+        except SyncError:
+            pass
+        return (
+            float(np.asarray(mc["good"].total)),
+            [m._is_synced for m in mc.values()],
+        )
+
+    for total, synced in lockstep.run(body, timeout=120.0):
+        assert not any(synced)
+        assert total in (1.0, 2.0)  # untouched local state
+
+
+def test_collection_fused_check_finite_raises_at_header(lockstep):
+    """A NaN-poisoned check_finite member must fail the FUSED header too:
+    the combined state's key-prefixed ``_nonfinite`` flag still reaches the
+    health word's poison verdict (member-grouped lookup in health.py)."""
+    from metrics_tpu.utils.exceptions import NonFiniteStateError
+
+    def body(rank):
+        mc = MetricCollection(
+            {"clean": _SumMetric(), "sick": _SumMetric().enable_check_finite()}
+        )
+        for m in mc.values():
+            m.distributed_available_fn = lambda: True
+        mc["clean"].update(jnp.asarray(1.0))
+        mc["sick"].update(jnp.asarray(jnp.nan if rank == 0 else 1.0))
+        try:
+            mc.sync(timeout=0)
+            raise AssertionError("fused sync of a poisoned member did not raise")
+        except NonFiniteStateError:
+            pass
+        return [m._is_synced for m in mc.values()]
+
+    for synced in lockstep.run(body):
+        assert not any(synced)  # all-or-nothing: raised before any mutation
+
+
+def test_collection_fused_unscreened_member_not_screened(lockstep):
+    """Per-member parity: a member that never opted into check_finite may
+    hold NaN and still sync — another member's poison flag must not screen
+    states outside its own group."""
+
+    def body(rank):
+        mc = MetricCollection(
+            {"nan": _SumMetric(), "screened": _SumMetric().enable_check_finite()}
+        )
+        for m in mc.values():
+            m.distributed_available_fn = lambda: True
+        mc["nan"].update(jnp.asarray(jnp.nan))  # unscreened, legal
+        mc["screened"].update(jnp.asarray(2.0))  # clean
+        mc.sync(timeout=0)
+        total = float(np.asarray(mc["screened"].total))
+        mc.unsync()
+        return total
+
+    assert lockstep.run(body) == [4.0, 4.0]
+
+
+# ---------------------------------------------------------------------------
+# gather_all_arrays all_shapes (satellite)
+# ---------------------------------------------------------------------------
+
+def test_gather_all_arrays_skips_shape_gather_with_known_shapes(lockstep):
+    def body(rank):
+        x = jnp.arange(4, dtype=jnp.float32) + rank
+        shapes = np.tile(np.asarray([4], np.int32), (WORLD, 1))
+        return gather_all_arrays(x, timeout=0, all_shapes=shapes)
+
+    out = lockstep.run(body)
+    assert lockstep.calls == 1  # payload only, no shape pre-gather
+    np.testing.assert_array_equal(np.asarray(out[0][1]), np.arange(4, dtype=np.float32) + 1)
+    lockstep.calls = 0
+
+    def body_unknown(rank):
+        return gather_all_arrays(jnp.arange(4.0) + rank, timeout=0)
+
+    lockstep.run(body_unknown)
+    assert lockstep.calls == 2  # shape gather + payload
+
+
+def test_gather_all_arrays_validates_all_shapes():
+    with pytest.raises(ValueError, match="all_shapes"):
+        # world == 1 short-circuits, so fake a 2-process world via the arg check
+        import unittest.mock as mock
+
+        with mock.patch.object(jax, "process_count", lambda: 2):
+            gather_all_arrays(jnp.zeros((3,)), all_shapes=np.zeros((3, 1), np.int32))
+
+
+# ---------------------------------------------------------------------------
+# in-jit fused mode + callable list fx (satellites)
+# ---------------------------------------------------------------------------
+
+def _mesh(n):
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:n]), axis_names=("dp",))
+
+
+def test_sync_in_jit_fused_matches_per_leaf():
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh(4)
+    reds = {"a": "sum", "b": "mean", "c": "sum", "mx": "max", "mn": "min"}
+
+    def run(fused):
+        @partial(
+            shard_map, mesh=mesh,
+            in_specs=(P("dp"),) * 5, out_specs=P(),
+        )
+        def step(a, b, c, mx, mn):
+            local = {
+                "a": a.reshape(1, 2), "b": b.reshape(()), "c": c.reshape(2),
+                "mx": mx.reshape(()), "mn": mn.reshape(()),
+            }
+            return sync_in_jit(local, reds, "dp", fused=fused)
+
+        return jax.jit(step)(
+            jnp.ones((4, 2)), jnp.asarray([0.5] * 4),
+            jnp.asarray([[1, 2], [3, 4], [5, 6], [7, 8]], jnp.int32),
+            jnp.arange(4.0), jnp.arange(4.0) + 10,
+        )
+
+    per_leaf, fused = run(False), run(True)
+    for name in per_leaf:
+        a, b = np.asarray(per_leaf[name]), np.asarray(fused[name])
+        assert a.dtype == b.dtype and np.array_equal(a, b), name
+
+
+def test_sync_in_jit_list_state_respects_callable_fx():
+    from functools import partial
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mesh = _mesh(4)
+
+    def custom(v, axis_name):
+        return jax.lax.psum(v, axis_name)
+
+    @partial(shard_map, mesh=mesh, in_specs=(P("dp"),), out_specs=P())
+    def step(x):
+        out = sync_in_jit({"l": [x.reshape(2)]}, {"l": custom}, "dp")
+        return out["l"][0]
+
+    result = jax.jit(step)(jnp.arange(8.0))
+    # psum keeps the local shape; the old code forced "cat" (all_gather → (8,))
+    assert result.shape == (2,)
+    np.testing.assert_array_equal(np.asarray(result), [0 + 2 + 4 + 6, 1 + 3 + 5 + 7])
+
+
+# ---------------------------------------------------------------------------
+# compile cache env knob (satellite)
+# ---------------------------------------------------------------------------
+
+def test_compile_cache_env_knob(monkeypatch, tmp_path):
+    from metrics_tpu.utils import compile_cache
+
+    monkeypatch.delenv(compile_cache.ENV_VAR, raising=False)
+    assert compile_cache.enable_from_env() is None
+    monkeypatch.setenv(compile_cache.ENV_VAR, "0")
+    assert compile_cache.enable_from_env() is None
+    monkeypatch.setenv(compile_cache.ENV_VAR, "off")
+    assert compile_cache.enable_from_env() is None
+    target = str(tmp_path / "xla-cache")
+    monkeypatch.setenv(compile_cache.ENV_VAR, target)
+    path = compile_cache.enable_from_env()
+    assert path == os.path.abspath(target) and os.path.isdir(path)

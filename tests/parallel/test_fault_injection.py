@@ -26,11 +26,13 @@ import pytest
 import metrics_tpu.parallel.sync as sync_mod
 from metrics_tpu.core.cat_buffer import CatBuffer
 from metrics_tpu.parallel.health import (
+    CAT_LENGTH_SLOTS,
     COUNT_SLOTS,
     HEALTH_PROTOCOL_VERSION,
     NONFINITE_STATE,
     WORD_WIDTH,
     _F_FIXED,
+    _F_LENGTHS,
     _F_NONFINITE,
     _F_NSTATES,
     _F_OVERFLOW,
@@ -124,13 +126,17 @@ def test_health_word_layout():
     state, reds = _catbuf_state(rows=3)
     word = build_health_word(state, reds, update_count=7)
     assert word.dtype == np.int32 and word.shape == (WORD_WIDTH,)
-    assert WORD_WIDTH == _F_FIXED + COUNT_SLOTS  # fixed width for EVERY metric
+    # fixed width for EVERY metric: v2 = fixed cols + count slots + the
+    # bucketed planner's per-cat-state row-length slots
+    assert WORD_WIDTH == _F_FIXED + COUNT_SLOTS + CAT_LENGTH_SLOTS
     assert word[_F_VERSION] == HEALTH_PROTOCOL_VERSION
     assert word[_F_UPDATES] == 7
     assert word[_F_OVERFLOW] == 0 and word[_F_NONFINITE] == 0
     assert word[_F_NSTATES] == 1
-    assert word[_F_FIXED] == 3  # CatBuffer fill count in the first slot
-    assert (word[_F_FIXED + 1 :] == -1).all()  # unused slots hold the sentinel
+    assert word[_F_FIXED] == 3  # CatBuffer fill count in the first count slot
+    assert (word[_F_FIXED + 1 : _F_LENGTHS] == -1).all()  # unused count slots
+    assert word[_F_LENGTHS] == 3  # CatBuffer row count in the first length slot
+    assert (word[_F_LENGTHS + 1 :] == -1).all()  # unused length slots
 
     state["preds"].overflowed = jnp.ones((), jnp.bool_)
     assert build_health_word(state, reds)[_F_OVERFLOW] == 1
@@ -215,7 +221,8 @@ def test_healthy_words_verify_clean():
 # typed raise BEFORE any payload gather
 # ---------------------------------------------------------------------------
 
-def test_divergent_rank_raises_before_payload_gather(fake_world):
+@pytest.mark.parametrize("fused", [True, False], ids=["fused", "per-leaf"])
+def test_divergent_rank_raises_before_payload_gather(fake_world, fused):
     def diverge(word):
         word[_F_SCHEMA] = (int(word[_F_SCHEMA]) + 1) & 0x7FFFFFFF
         return word
@@ -223,21 +230,32 @@ def test_divergent_rank_raises_before_payload_gather(fake_world):
     ag = fake_world(EchoAllgather(mutate_first=diverge))
     state, reds = _catbuf_state()
     with pytest.raises(StateDivergenceError):
-        host_sync_state(state, reds, update_count=1)
+        host_sync_state(state, reds, update_count=1, fused=fused)
     # symmetric-failure contract: the raise happened on the header gather,
-    # so no rank can be stranded inside a later payload collective
+    # so no rank can be stranded inside a later payload collective — on the
+    # fused path included (the planner only runs after a verified header)
     assert ag.calls == 1
 
 
-def test_healthy_sync_collapses_per_leaf_prechecks(fake_world):
+def test_healthy_sync_collapses_per_leaf_prechecks(fake_world, monkeypatch):
     ag = fake_world(EchoAllgather())
     state, reds = _catbuf_state(rows=3)
     state["n"], reds["n"] = jnp.ones(()), "sum"
     out = host_sync_state(state, reds, update_count=1)
-    # 1 header + per leaf (shape gather + payload gather) and ZERO per-leaf
-    # count/flag prechecks — the old protocol cost up to 2 extra per state
-    assert ag.calls == 1 + 2 * len(state)
+    # fused default: 1 header + 1 f32 reduce bucket + 1 f32 cat bucket,
+    # and ZERO per-leaf count/flag/shape gathers
+    assert ag.calls == 3
     assert len(out["preds"]) == WORLD * 3  # both ranks' rows merged
+    np.testing.assert_allclose(np.asarray(out["n"]), WORLD * 1.0)
+
+    # escape hatch: per-leaf payloads (CatBuffer pays a shape gather; the
+    # sum leaf's shape is schema-verified so its shape gather is skipped),
+    # still zero per-leaf prechecks — the old protocol cost up to 2 extra
+    monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "0")
+    ag.calls = 0
+    out = host_sync_state(state, reds, update_count=1)
+    assert ag.calls == 1 + 2 + 1
+    assert len(out["preds"]) == WORLD * 3
     np.testing.assert_allclose(np.asarray(out["n"]), WORLD * 1.0)
 
 
@@ -248,12 +266,13 @@ def test_slow_but_live_peer_completes_within_timeout(fake_world):
     np.testing.assert_allclose(np.asarray(out["x"]), WORLD * 1.0)
 
 
-def test_dead_peer_raises_sync_timeout(fake_world):
+@pytest.mark.parametrize("fused", [True, False], ids=["fused", "per-leaf"])
+def test_dead_peer_raises_sync_timeout(fake_world, fused):
     fake_world(EchoAllgather(delay_s=3.0))  # "dead" at the watchdog's scale
     state, reds = _sum_state()
     t0 = time.perf_counter()
     with pytest.raises(SyncTimeoutError, match="dead or stalled"):
-        host_sync_state(state, reds, timeout=0.2)
+        host_sync_state(state, reds, timeout=0.2, fused=fused)
     assert time.perf_counter() - t0 < 2.0  # raised, did not block out the call
 
 
@@ -379,13 +398,26 @@ def test_metric_on_error_local_degrades_to_local_compute(fake_world):
     assert not m._is_synced
 
 
-def test_metric_on_error_local_timeout_degrades(fake_world):
+@pytest.mark.parametrize("fused", [None, True, False], ids=["env-default", "fused", "per-leaf"])
+def test_metric_on_error_local_timeout_degrades(fake_world, fused):
     m = _distributed_metric(fake_world, EchoAllgather(delay_s=3.0))
+    m.sync_fused = fused  # the per-metric knob threads through _run_dist_sync
     m.update(jnp.asarray(1.0))
     with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
         m.sync(on_error="local", timeout=0.2)
     assert not m._is_synced
     np.testing.assert_allclose(np.asarray(m.x), 1.0)
+
+
+def test_overflowed_peer_raises_before_fused_payload(fake_world):
+    # a corrupt CatBuffer poisons the merge on both payload strategies; the
+    # header raises before the planner ever builds a payload buffer
+    ag = fake_world(EchoAllgather())
+    state, reds = _catbuf_state()
+    state["preds"].overflowed = jnp.ones((), jnp.bool_)
+    with pytest.raises(SyncError, match="overflowed"):
+        host_sync_state(state, reds, update_count=1, fused=True)
+    assert ag.calls == 1
 
 
 def test_metric_on_error_warn_warns_on_every_rank(fake_world):
