@@ -3,10 +3,11 @@
 Default (driver contract): runs BASELINE config 1 and prints ONE JSON line
 ``{"metric", "value", "unit", "vs_baseline"}``.
 
-``python bench.py --all`` additionally runs configs 2-8 (one JSON line
+``python bench.py --all`` additionally runs configs 2-9 (one JSON line
 each; ``--config N`` runs a single one; see BASELINE.md for the config
 table and BENCH.md for recorded numbers; config 8 is the host-sync
-collective-fusion accounting added with the bucketed planner).
+collective-fusion accounting added with the bucketed planner, config 9 the
+compute-group update/state dedup accounting).
 
 Timing methodology (see BENCH.md): hot paths are timed **on-chip** by
 scanning K steps inside ONE jitted program (``lax.scan``) and dividing — a
@@ -1327,6 +1328,135 @@ def bench_config8() -> None:
           round(leaf_n / fused_n, 3))
 
 
+def bench_config9() -> None:
+    """Config 9: compute-group dedup — grouped vs ungrouped collection cost.
+
+    The ISSUE-3 acceptance measurement: a 4-metric stat-score collection
+    (Precision / Recall / F1 / Specificity, equal args — one compute group)
+    is driven through `update` with compute groups on and off, counting
+    `_stat_scores_update` dispatches and timing the eager per-step update
+    wall clock, then host-synced through the fused planner at a simulated
+    W=8 world (config 8's counting-echo seam) to account collectives and
+    payload bytes. Asserts (CI gates contract):
+
+    - grouped update dispatches ≤ ungrouped / 2 (a 4-member group runs ONE
+      stat-score update per step — a 4x dispatch reduction);
+    - grouped fused-sync payload bytes strictly below ungrouped (one
+      gathered tp/fp/tn/fn quartet instead of four), with no more
+      collectives.
+
+    Emits `collection_update_us_per_step` (grouped) with `vs_baseline` =
+    ungrouped/grouped wall-clock ratio; the dispatch counts, payload bytes
+    and header column usage ride the diagnostic line.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    import metrics_tpu.classification.stat_scores as stat_scores_mod
+    import metrics_tpu.parallel.sync as sync_mod
+    from metrics_tpu import F1, Precision, Recall, Specificity
+    from metrics_tpu.core.collections import MetricCollection
+    from metrics_tpu.parallel.bucketing import clear_sync_plan_cache
+
+    W = 8
+    STEPS = 30
+
+    class _CountingEcho:
+        def __init__(self):
+            self.calls = 0
+            self.bytes = 0
+
+        def __call__(self, x):
+            self.calls += 1
+            row = np.asarray(x)
+            self.bytes += row.nbytes * W
+            return jnp.asarray(np.stack([row.copy() for _ in range(W)]))
+
+    def make(grouped: bool) -> MetricCollection:
+        return MetricCollection(
+            {
+                "prec": Precision(num_classes=NUM_CLASSES, average="macro"),
+                "rec": Recall(num_classes=NUM_CLASSES, average="macro"),
+                "f1": F1(num_classes=NUM_CLASSES, average="macro"),
+                "spec": Specificity(num_classes=NUM_CLASSES, average="macro"),
+            },
+            compute_groups=grouped,
+        )
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
+    target = jnp.asarray(rng.randint(0, NUM_CLASSES, (BATCH,)))
+
+    dispatches = {}
+    orig_update = stat_scores_mod._stat_scores_update
+    counter = {"n": 0}
+
+    def counting(*args, **kwargs):
+        counter["n"] += 1
+        return orig_update(*args, **kwargs)
+
+    step_us = {}
+    stat_scores_mod._stat_scores_update = counting
+    try:
+        for mode in ("grouped", "ungrouped"):
+            mc = make(mode == "grouped")
+            mc.update(preds, target)  # warm: group planning + jit compile
+            counter["n"] = 0
+            jax.block_until_ready(mc["prec"]._state["tp"])
+            t0 = time.perf_counter()
+            for _ in range(STEPS):
+                mc.update(preds, target)
+            jax.block_until_ready(mc["prec"]._state["tp"])
+            step_us[mode] = (time.perf_counter() - t0) / STEPS * 1e6
+            dispatches[mode] = counter["n"] / STEPS
+    finally:
+        stat_scores_mod._stat_scores_update = orig_update
+
+    # per-step dispatch dedup: the 4-member group must run ONE update
+    assert dispatches["grouped"] * 2 <= dispatches["ungrouped"], dispatches
+
+    saved_count, saved_seam = jax.process_count, sync_mod._raw_process_allgather
+    sync_counts = {}
+    try:
+        jax.process_count = lambda: W
+        for mode in ("grouped", "ungrouped"):
+            clear_sync_plan_cache()
+            echo = _CountingEcho()
+            sync_mod._raw_process_allgather = echo
+            mc = make(mode == "grouped")
+            mc.update(preds, target)
+            mc.sync(timeout=0)
+            mc.unsync()
+            # unique states the combined fused plan carried (header columns)
+            n_keys = sum(len(m._state) for _k, m, _p in mc._sync_state_owners())
+            sync_counts[mode] = {"collectives": echo.calls, "bytes": echo.bytes, "state_keys": n_keys}
+    finally:
+        jax.process_count = saved_count
+        sync_mod._raw_process_allgather = saved_seam
+        clear_sync_plan_cache()
+
+    # sync dedup: strictly fewer payload bytes, no more collectives, and a
+    # 4x smaller combined header (4 unique state keys instead of 16)
+    assert sync_counts["grouped"]["bytes"] < sync_counts["ungrouped"]["bytes"], sync_counts
+    assert sync_counts["grouped"]["collectives"] <= sync_counts["ungrouped"]["collectives"], sync_counts
+    assert sync_counts["grouped"]["state_keys"] < sync_counts["ungrouped"]["state_keys"], sync_counts
+
+    _diag(
+        config=9,
+        world=W,
+        members=4,
+        update_dispatches_per_step=dispatches,
+        update_us_per_step={m: round(v, 2) for m, v in step_us.items()},
+        fused_sync={m: dict(c) for m, c in sync_counts.items()},
+    )
+    _emit(
+        "collection_update_us_per_step",
+        round(step_us["grouped"], 2),
+        "us/step",
+        round(step_us["ungrouped"] / step_us["grouped"], 3),
+    )
+
+
 def main() -> None:
     try:
         platform = _ensure_backend()
@@ -1352,7 +1482,7 @@ def main() -> None:
     except Exception:
         vs = None
     _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
-    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8}
+    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9}
     if "--config" in sys.argv:
         i = sys.argv.index("--config") + 1
         key = sys.argv[i] if i < len(sys.argv) else None

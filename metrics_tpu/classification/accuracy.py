@@ -6,7 +6,7 @@ Behavioral analogue of the reference's
 subset-accuracy path (reference ``accuracy.py:203-204``) and per-batch input
 mode detection (reference ``functional/classification/accuracy.py:29``).
 """
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import jax.numpy as jnp
 from jax import Array
@@ -120,6 +120,30 @@ class Accuracy(StatScores):
 
         self.add_state("correct", default=jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
         self.add_state("total", default=jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
+
+    #: Accuracy's update infers the input mode once and may drop the
+    #: subset-accuracy branch on incompatible input; a grouped dispatch
+    #: copies both latches to every sibling so their compute() sees exactly
+    #: what their own update would have inferred.
+    _group_shared_attrs = ("mode", "subset_accuracy")
+
+    def update_identity(self) -> Optional[Tuple]:
+        """Compute-group key: Accuracy overrides the stat-score ``update``
+        (mode detection + the subset-accuracy branch + extra correct/total
+        states), so it only groups with other ``Accuracy`` instances whose
+        full configuration matches — never with the plain stat-score family.
+        """
+        return (
+            "accuracy",
+            self.reduce,
+            self.mdmc_reduce,
+            self.threshold,
+            self.num_classes,
+            self.top_k,
+            self.multiclass,
+            self.ignore_index,
+            self.subset_accuracy,
+        )
 
     def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
         """Accumulate either subset-accuracy counts or stat scores."""

@@ -104,6 +104,21 @@ class AUROC(Metric):
         self.add_state("preds", default=[], dist_reduce_fx="cat")
         self.add_state("target", default=[], dist_reduce_fx="cat")
 
+    #: AUROC's update latches the detected input mode; a grouped dispatch
+    #: copies the latch to every sibling
+    _group_shared_attrs = ("mode",)
+
+    def update_identity(self):
+        """Compute-group key. ``_auroc_update`` takes no configuration —
+        every AUROC instance preprocesses identically (mode detection +
+        multidim flattening) — so any set of AUROC members shares one
+        preds/target accumulation regardless of ``average``/``num_classes``
+        (those only shape ``compute``). It does NOT share the clf-curve
+        family's key: ``_precision_recall_curve_update`` reshapes/ravels
+        where ``_auroc_update`` stores rows as-is, so their accumulated
+        states are not provably identical."""
+        return ("auroc",)
+
     def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
         preds, target, mode = _auroc_update(preds, target)
         self.preds.append(preds)

@@ -79,6 +79,22 @@ class AveragePrecision(Metric):
         self.add_state("preds", default=[], dist_reduce_fx="cat")
         self.add_state("target", default=[], dist_reduce_fx="cat")
 
+    #: the shared clf-curve preprocessing infers num_classes/pos_label; a
+    #: grouped dispatch copies the inference to every sibling
+    _group_shared_attrs = ("num_classes", "pos_label")
+
+    def update_identity(self):
+        """Compute-group key. ``_average_precision_update`` delegates to
+        ``_precision_recall_curve_update`` and, for every ``average`` except
+        ``"micro"``, returns its result untouched — so non-micro instances
+        share the clf-curve family key (ROC / PrecisionRecallCurve /
+        AveragePrecision with equal ``(num_classes, pos_label)`` hold one
+        preds/target accumulation). ``"micro"`` additionally ravels
+        multilabel input and only groups with other micro instances."""
+        if self.average == "micro":
+            return ("clf_curve_micro", self.num_classes, self.pos_label)
+        return ("clf_curve", self.num_classes, self.pos_label)
+
     def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
         preds, target, num_classes, pos_label = _average_precision_update(
             preds, target, self.num_classes, self.pos_label, self.average

@@ -62,6 +62,12 @@ class HammingDistance(Metric):
         self.add_state("total", default=jnp.zeros((), dtype=jnp.int32), dist_reduce_fx="sum")
         self.threshold = threshold
 
+    def update_identity(self):
+        """Compute-group key: HammingDistance's update is parameterized by
+        ``threshold`` alone, so equal-threshold instances in a collection
+        share one correct/total accumulation."""
+        return ("hamming_distance", self.threshold)
+
     def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
         correct, total = _hamming_distance_update(preds, target, self.threshold)
         self.correct = self.correct + correct

@@ -72,6 +72,17 @@ class PrecisionRecallCurve(Metric):
         self.add_state("preds", default=[], dist_reduce_fx="cat")
         self.add_state("target", default=[], dist_reduce_fx="cat")
 
+    #: the shared clf-curve preprocessing infers num_classes/pos_label; a
+    #: grouped dispatch copies the inference to every sibling
+    _group_shared_attrs = ("num_classes", "pos_label")
+
+    def update_identity(self):
+        """Compute-group key of the clf-curve family (see ``ROC``): this
+        update is the defining ``_precision_recall_curve_update`` call, so
+        equal ``(num_classes, pos_label)`` instances — including ROC and
+        non-micro AveragePrecision — share one preds/target accumulation."""
+        return ("clf_curve", self.num_classes, self.pos_label)
+
     def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
         preds, target, num_classes, pos_label = _precision_recall_curve_update(
             preds, target, self.num_classes, self.pos_label

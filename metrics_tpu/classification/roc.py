@@ -66,6 +66,20 @@ class ROC(Metric):
         self.add_state("preds", default=[], dist_reduce_fx="cat")
         self.add_state("target", default=[], dist_reduce_fx="cat")
 
+    #: the shared clf-curve preprocessing infers num_classes/pos_label; a
+    #: grouped dispatch copies the inference to every sibling
+    _group_shared_attrs = ("num_classes", "pos_label")
+
+    def update_identity(self):
+        """Compute-group key of the clf-curve family: ``_roc_update`` IS
+        ``_precision_recall_curve_update``, so ROC, PrecisionRecallCurve and
+        (non-micro) AveragePrecision instances with equal
+        ``(num_classes, pos_label)`` append bit-identical preds/target rows
+        — a ``MetricCollection`` holds ONE shared preds/target accumulation
+        (list or CatBuffer) for the whole group instead of one per metric.
+        """
+        return ("clf_curve", self.num_classes, self.pos_label)
+
     def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
         preds, target, num_classes, pos_label = _roc_update(preds, target, self.num_classes, self.pos_label)
         self.preds.append(preds)

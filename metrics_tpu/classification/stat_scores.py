@@ -121,6 +121,31 @@ class StatScores(Metric):
         for s in ("tp", "fp", "tn", "fn"):
             self.add_state(s, default=[] if isinstance(default, list) else default, dist_reduce_fx=reduce_fn)
 
+    def update_identity(self) -> Optional[Tuple]:
+        """Compute-group key of the stat-score family.
+
+        Every metric that inherits this ``update`` unchanged — Precision,
+        Recall, FBeta/F1, Specificity, StatScores itself — folds batches
+        through the identical ``_stat_scores_update`` call, parameterized
+        only by the arguments below. Members of a ``MetricCollection`` with
+        equal keys therefore run ONE tp/fp/tn/fn accumulation per step and
+        share one copy of the counters; each still reduces its own value at
+        ``compute``. Subclasses that override ``update`` (e.g. ``Accuracy``,
+        whose update latches an input-mode attribute and takes a subset-
+        accuracy branch) are automatically excluded unless they re-declare
+        their own key (see ``Metric._effective_update_identity``).
+        """
+        return (
+            "stat_scores",
+            self.reduce,
+            self.mdmc_reduce,
+            self.threshold,
+            self.num_classes,
+            self.top_k,
+            self.multiclass,
+            self.ignore_index,
+        )
+
     def update(self, preds: Array, target: Array) -> None:  # type: ignore[override]
         """Accumulate tp/fp/tn/fn from a batch of (preds, target)."""
         tp, fp, tn, fn = _stat_scores_update(

@@ -22,7 +22,13 @@ batch count retraces the jitted step, and collectives need static shapes
 
 Opt in per metric via ``metric.with_capacity(n)``: every declared list state
 becomes a ``CatBuffer``; the metric's ``update``/``compute`` code is unchanged
-(``.append`` and ``dim_zero_cat`` dispatch on the type).
+(``.append`` and ``dim_zero_cat`` dispatch on the type). In a
+``MetricCollection`` compute group (``core/collections.py``), curve metrics
+with equal capacities share ONE CatBuffer object for the whole group — a
+K-metric ROC/PR/AP collection holds one ``[capacity, ...]`` buffer instead
+of K, and a stray out-of-group ``update`` copies the buffer wrapper
+(``copy()`` — the underlying array is immutable, so the copy is O(1) until
+the next append replaces it) before diverging.
 
 Eager appends past capacity raise. Inside jit (no exceptions possible) an
 overflowing write clamps at the end of the buffer, the fill count saturates

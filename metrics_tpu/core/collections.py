@@ -4,14 +4,90 @@ Behavioral analogue of the reference's ``torchmetrics/collections.py:26-235``.
 TPU upgrade: :meth:`pure_forward` traces *all* member metrics' update + sync +
 compute into a single XLA program, so a collection costs one fused reduction
 over the mesh instead of one gather per metric (the BASELINE north star).
+
+**Compute groups** (this module's second performance seam): members whose
+state schema and update are provably identical — equal
+:meth:`~metrics_tpu.Metric.state_fingerprint` AND equal
+:meth:`~metrics_tpu.Metric.update_identity` — are grouped so the whole group
+runs ONE update per step and owns ONE copy of state; the other members hold
+views (aliases) into the shared arrays/containers. A collection of
+Precision + Recall + F1 + Specificity with equal args pays for one
+stat-score update instead of four, and ROC + PrecisionRecallCurve +
+AveragePrecision share one preds/target buffer instead of three. Grouping is
+automatic (``compute_groups=True`` default), overridable with an explicit
+``compute_groups=[["p", "r"], ...]`` partition, and disabled process-wide by
+``METRICS_TPU_COMPUTE_GROUPS=0``; results are bit-identical either way.
 """
+import os
 from contextlib import contextmanager
 from copy import deepcopy
-from typing import Any, Callable, Dict, Hashable, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
-from metrics_tpu.core.metric import _ON_ERROR_MODES, Metric, _copy_state_value
+import numpy as np
+
+from metrics_tpu.core.cat_buffer import CatBuffer
+from metrics_tpu.core.metric import (
+    _ComputeGroup,
+    _ON_ERROR_MODES,
+    Metric,
+    _copy_state_value,
+)
 from metrics_tpu.parallel.health import FUSED_KEY_SEP as _FUSED_KEY_SEP
+from metrics_tpu.utils.data import is_traced
 from metrics_tpu.utils.exceptions import MetricsTPUUserError, SyncError
+
+#: Env escape hatch: set to 0/false/off to disable compute-group formation
+#: (every member then updates and owns state independently, as before).
+COMPUTE_GROUPS_ENV = "METRICS_TPU_COMPUTE_GROUPS"
+
+
+def compute_groups_enabled() -> bool:
+    """Default grouping policy: on, unless the env knob opts the process out."""
+    return os.environ.get(COMPUTE_GROUPS_ENV, "1").strip().lower() not in ("0", "false", "off", "no")
+
+
+def _leaf_concrete_equal(a: Any, b: Any) -> bool:
+    """Conservative bit-equality of two state leaves; traced leaves (whose
+    bytes cannot be read) report unequal so grouping never guesses."""
+    if a is b:
+        return True
+    if isinstance(a, CatBuffer) or isinstance(b, CatBuffer):
+        if not (isinstance(a, CatBuffer) and isinstance(b, CatBuffer)):
+            return False
+        if a.capacity != b.capacity:
+            return False
+        for leaf_a, leaf_b in ((a.count, b.count), (a.overflowed, b.overflowed)):
+            if is_traced(leaf_a) or is_traced(leaf_b):
+                return False
+            if np.asarray(leaf_a) != np.asarray(leaf_b):
+                return False
+        if (a.buffer is None) != (b.buffer is None):
+            return False
+        if a.buffer is None:
+            return True
+        return _leaf_concrete_equal(a.buffer, b.buffer)
+    if isinstance(a, (list, tuple)) or isinstance(b, (list, tuple)):
+        if not (isinstance(a, (list, tuple)) and isinstance(b, (list, tuple))):
+            return False
+        if len(a) != len(b):
+            return False
+        return all(_leaf_concrete_equal(x, y) for x, y in zip(a, b))
+    if is_traced(a) or is_traced(b):
+        return False
+    a, b = np.asarray(a), np.asarray(b)
+    return a.dtype == b.dtype and a.shape == b.shape and a.tobytes() == b.tobytes()
+
+
+def _concrete_states_equal(a: Metric, b: Metric) -> bool:
+    """Can ``b`` share ``a``'s state right now? Requires equal update counts
+    (the sync header would otherwise diverge) and bit-equal state leaves."""
+    if a._is_synced or b._is_synced:
+        return False
+    if getattr(a, "_update_count", 0) != getattr(b, "_update_count", 0):
+        return False
+    if sorted(a._defaults) != sorted(b._defaults):
+        return False
+    return all(_leaf_concrete_equal(a._state[name], b._state[name]) for name in a._defaults)
 
 
 class MetricCollection(dict):
@@ -33,6 +109,30 @@ class MetricCollection(dict):
     (``METRICS_TPU_FUSED_SYNC=0`` restores the per-member loop).
     ``clone(prefix=...)`` gives cheap train/val/test copies.
 
+    **Compute groups.** With ``compute_groups=True`` (the default), members
+    whose state schema (:meth:`~metrics_tpu.Metric.state_fingerprint`) and
+    update (:meth:`~metrics_tpu.Metric.update_identity`) are provably
+    identical share ONE update and ONE copy of state per step: the group's
+    first member in collection order runs the update, and every other
+    member's state leaves alias the same arrays/containers (each
+    ``compute()`` still reduces independently, so results are bit-identical
+    to ungrouped). The deduplication carries through the whole stack — the
+    fused host sync gathers one payload per group instead of one per
+    member, and ``pure_update``/``pure_sync`` trace each group's collective
+    work once. With ``with_capacity(n)`` curve members, the whole group
+    shares ONE :class:`~metrics_tpu.CatBuffer` — a K× memory reduction for
+    a K-metric curve collection (capacities must match to group). A direct
+    out-of-group ``update()``/``reset()``/``load_state_dict()`` on a single
+    member copies-on-write out of its group, so divergence is always safe;
+    ``on_error="local"``/``"warn"`` sync degradation falls back per member
+    with the group's shared views intact, and per-member sync
+    knobs (``sync_fused``, ``sync_on_error``, ``sync_timeout``,
+    ``sync_strict_update_count``, custom ``dist_sync_fn``) must match
+    across a group — members that differ simply stay ungrouped. Pass
+    ``compute_groups=[["a","b"], ...]`` to pin the partition explicitly
+    (schema mismatches raise), or ``compute_groups=False`` /
+    ``METRICS_TPU_COMPUTE_GROUPS=0`` to disable.
+
     Example:
         >>> import jax.numpy as jnp
         >>> from metrics_tpu import Accuracy, MetricCollection, Precision
@@ -47,6 +147,9 @@ class MetricCollection(dict):
     Args:
         metrics: one Metric, a list/tuple of Metrics, or a dict name->Metric.
         prefix / postfix: added to every key in the output dict.
+        compute_groups: ``True`` (default) groups schema/update-identical
+            members automatically; a list of key-lists pins the groups
+            explicitly; ``False`` disables grouping.
     """
 
     def __init__(
@@ -55,10 +158,28 @@ class MetricCollection(dict):
         *additional_metrics: Metric,
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
+        compute_groups: Union[bool, Sequence[Sequence[str]]] = True,
     ) -> None:
         super().__init__()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
+        if not (
+            isinstance(compute_groups, bool)
+            or (
+                isinstance(compute_groups, (list, tuple))
+                and all(
+                    isinstance(grp, (list, tuple)) and all(isinstance(k, str) for k in grp)
+                    for grp in compute_groups
+                )
+            )
+        ):
+            raise MetricsTPUUserError(
+                "`compute_groups` must be a bool or a list of lists of metric "
+                f"keys, got {compute_groups!r}"
+            )
+        self._compute_groups_arg = compute_groups
+        self._groups_planned = False
+        self._groups_stale = True
         self.add_metrics(metrics, *additional_metrics)
 
     @staticmethod
@@ -116,6 +237,9 @@ class MetricCollection(dict):
                         self[k] = v
         else:
             raise ValueError("Unknown input to MetricCollection.")
+        # membership changed: re-plan compute groups at the next dispatch
+        self._groups_planned = False
+        self._groups_stale = True
 
     def _set_name(self, base: str) -> str:
         name = base if self.prefix is None else self.prefix + base
@@ -133,25 +257,382 @@ class MetricCollection(dict):
             return super().keys()
         return [self._set_name(k) for k in super().keys()]
 
+    # ---------------- compute-group planner ----------------
+
+    @property
+    def compute_group_keys(self) -> List[List[str]]:
+        """The live compute groups as lists of member keys (empty when
+        grouping is disabled or no members qualify). Builds lazily."""
+        self._ensure_groups()
+        groups: Dict[int, List[str]] = {}
+        order: List[int] = []
+        for k, m in super().items():
+            g = m._compute_group
+            if g is None:
+                continue
+            if id(g) not in groups:
+                order.append(id(g))
+            groups.setdefault(id(g), []).append(k)
+        return [groups[i] for i in order if len(groups[i]) >= 2]
+
+    def _iter_group_objects(self) -> Iterator[_ComputeGroup]:
+        seen: set = set()
+        for m in super().values():
+            g = m._compute_group
+            if g is not None and id(g) not in seen:
+                seen.add(id(g))
+                yield g
+
+    def _ensure_groups(self) -> None:
+        """Build (or rebuild) the compute-group partition.
+
+        Members group when they have (a) an equal, non-``None``
+        ``update_identity`` — the family's promise that their updates are
+        the same computation — (b) an equal ``state_fingerprint`` (identical
+        ``add_state`` schemas), (c) equal sync configuration (a group syncs
+        through one member, so its knobs must speak for all), and (d)
+        bit-equal current state (a member updated out of band keeps its own
+        state). Same construction + same feed history → same groups on
+        every rank. The state-equality condition means rank-LOCAL
+        divergence (direct per-member updates, per-rank checkpoints) can
+        legally produce different partitions per rank; the sync layer is
+        built to survive that — the fused path's combined header verifies
+        the partition-dependent key set across ranks before any payload
+        gather (symmetric ``StateDivergenceError`` on mismatch), and the
+        per-member loop never dedupes, so its collective schedule is
+        partition-independent.
+        """
+        if self._groups_planned and not self._groups_stale:
+            return
+        self._groups_planned = True
+        self._groups_stale = False
+        self._dissolve_groups()
+        arg = self._compute_groups_arg
+        if arg is False or not compute_groups_enabled():
+            return
+        members = list(super().items())
+        if len(members) < 2:
+            return
+        if isinstance(arg, (list, tuple)):
+            self._link_explicit_groups(arg, dict(members))
+            return
+        # a metric object registered under several keys updates once per key
+        # (historical semantics) — it must never group with itself
+        occurrences: Dict[int, int] = {}
+        for _k, m in members:
+            occurrences[id(m)] = occurrences.get(id(m), 0) + 1
+        buckets: Dict[Any, List[Tuple[str, Metric]]] = {}
+        order: List[Any] = []
+        for k, m in members:
+            if m._is_synced or occurrences[id(m)] > 1:
+                continue
+            ident = m._effective_update_identity()
+            if ident is None:
+                continue
+            key = (ident, m.state_fingerprint()) + self._sync_config_key(m)
+            if key not in buckets:
+                order.append(key)
+            buckets.setdefault(key, []).append((k, m))
+        for key in order:
+            bucket = buckets[key]
+            if len(bucket) < 2:
+                continue
+            # split by current state: only members that are bit-equal right
+            # now may share (out-of-band updates keep a member solo)
+            subgroups: List[List[Tuple[str, Metric]]] = []
+            for k, m in bucket:
+                for sg in subgroups:
+                    if _concrete_states_equal(sg[0][1], m):
+                        sg.append((k, m))
+                        break
+                else:
+                    subgroups.append([(k, m)])
+            for sg in subgroups:
+                if len(sg) >= 2:
+                    self._link_group(sg)
+
+    @staticmethod
+    def _sync_config_key(m: Metric) -> Tuple:
+        """The per-member configuration a compute group must agree on beyond
+        the state schema: a group syncs and merges through ONE member, so
+        its transport/degradation/strictness knobs (and any ``merge_states``
+        override) speak for every sibling."""
+        return (
+            repr(m.process_group),
+            None if m.dist_sync_fn is None else id(m.dist_sync_fn),
+            getattr(m, "sync_on_error", "raise"),
+            bool(getattr(m, "sync_strict_update_count", False)),
+            getattr(m, "sync_fused", None),
+            getattr(m, "sync_timeout", None),
+            id(type(m).merge_states),
+        )
+
+    def _link_explicit_groups(
+        self, spec: Sequence[Sequence[str]], by_key: Dict[str, Metric]
+    ) -> None:
+        seen: set = set()
+        for group_keys in spec:
+            keys = list(group_keys)
+            for k in keys:
+                if k not in by_key:
+                    raise MetricsTPUUserError(
+                        f"compute_groups override names unknown metric {k!r}; "
+                        f"collection keys are {sorted(by_key)}"
+                    )
+                if k in seen:
+                    raise MetricsTPUUserError(
+                        f"compute_groups override lists metric {k!r} in more than one group"
+                    )
+                seen.add(k)
+            if len(keys) < 2:
+                continue
+            ms = [by_key[k] for k in keys]
+            collection_occurrences: Dict[int, int] = {}
+            for m in by_key.values():
+                collection_occurrences[id(m)] = collection_occurrences.get(id(m), 0) + 1
+            if any(collection_occurrences[id(m)] > 1 for m in ms):
+                # covers both two group keys holding one object AND an object
+                # grouped under one key while also registered under another:
+                # once-per-key update semantics cannot coexist with group
+                # dispatch deduplication for the same instance
+                raise MetricsTPUUserError(
+                    f"compute_groups override groups {keys}, but at least one of those "
+                    "metrics is registered under several collection keys — a metric "
+                    "registered under several keys updates once per key and cannot "
+                    "join a compute group."
+                )
+            fp0 = ms[0].state_fingerprint()
+            cfg0 = self._sync_config_key(ms[0])
+            for k, m in zip(keys[1:], ms[1:]):
+                if m.state_fingerprint() != fp0:
+                    raise MetricsTPUUserError(
+                        f"compute_groups override groups {keys}, but {k!r} declares a "
+                        f"different state schema than {keys[0]!r}: compute-group members "
+                        "must have identical `add_state` declarations (name/shape/dtype/"
+                        "default/dist_reduce_fx)."
+                    )
+                if self._sync_config_key(m) != cfg0:
+                    raise MetricsTPUUserError(
+                        f"compute_groups override groups {keys}, but {k!r} is configured "
+                        f"differently from {keys[0]!r} (process_group / dist_sync_fn / "
+                        "sync_on_error / sync_strict_update_count / sync_fused / "
+                        "sync_timeout / merge_states override): a group syncs through one "
+                        "member, so these knobs must match across the group."
+                    )
+                if not _concrete_states_equal(ms[0], m):
+                    raise MetricsTPUUserError(
+                        f"compute_groups override groups {keys}, but the current states of "
+                        f"{keys[0]!r} and {k!r} differ — group members must start from "
+                        "identical (e.g. freshly reset) state."
+                    )
+            self._link_group(list(zip(keys, ms)))
+
+    def _link_group(self, sg: List[Tuple[str, Metric]]) -> None:
+        metrics = [m for _, m in sg]
+        group = _ComputeGroup(metrics)
+        for m in metrics:
+            object.__setattr__(m, "_compute_group", group)
+        self._relink_group(group)
+
+    def _relink_group(self, group: _ComputeGroup, source: Optional[Metric] = None) -> None:
+        """Point every member's state leaves at ``source``'s objects (zero
+        copies — arrays are immutable, containers are shared in place) and
+        propagate the family's declared update side-effect attributes."""
+        if not group.members:
+            return
+        if source is None:
+            source = group.members[0]
+        for m in group.members:
+            if m is source:
+                continue
+            for name in source._state:
+                m._state[name] = source._state[name]
+            for name, d in source._defaults.items():
+                # an update materializes the dispatching member's CatBuffer
+                # DEFAULT (item spec fixed, see _wrap_update); propagate it so
+                # sibling fingerprints stay equal (groups survive reset) and
+                # sibling init_state() keeps a stable pytree structure
+                if (
+                    isinstance(d, CatBuffer)
+                    and d.buffer is not None
+                    and isinstance(m._defaults.get(name), CatBuffer)
+                    and m._defaults[name].buffer is None
+                ):
+                    m._defaults[name] = d
+            for attr in type(m)._group_shared_attrs:
+                if hasattr(source, attr):
+                    setattr(m, attr, getattr(source, attr))
+
+    def _relink_groups(self) -> None:
+        for group in self._iter_group_objects():
+            self._relink_group(group)
+
+    def _dissolve_groups(self) -> None:
+        for group in list(self._iter_group_objects()):
+            for m in group.members:
+                object.__setattr__(m, "_compute_group", None)
+            group.members.clear()
+
+    def _break_group(self, group: _ComputeGroup) -> None:
+        """Disband a group whose dispatch raised mid-mutation: every member
+        takes private copies of whatever state it currently sees and leaves
+        the group, so no later ``_relink_group`` can clobber a sibling with
+        the failed member's partial state. This reproduces the ungrouped
+        failure semantics — the member that was mid-update keeps its
+        partial/wiped state (exactly what a solo ``Metric.forward`` leaves
+        behind), untouched siblings keep their accumulation. ``reset()``
+        re-plans the partition, so the group re-forms on the next epoch."""
+        members = list(group.members)
+        group.members.clear()
+        for m in members:
+            object.__setattr__(m, "_compute_group", None)
+            m._state = {k: _copy_state_value(v) for k, v in m._state.items()}
+        self._groups_stale = True
+
+    # ---------------- forward / update / compute ----------------
+
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        return {
-            self._set_name(k): m(*args, **m._filter_kwargs(**kwargs))
-            for k, m in super().items()
-        }
+        self._ensure_groups()
+        out: Dict[str, Any] = {}
+        group_values: Dict[int, Dict[int, Any]] = {}
+        for k, m in super().items():
+            g = m._compute_group
+            if g is None:
+                out[self._set_name(k)] = m(*args, **m._filtered_kwargs(kwargs))
+            else:
+                if id(g) not in group_values:
+                    group_values[id(g)] = self._group_forward(g, m, args, kwargs)
+                out[self._set_name(k)] = group_values[id(g)][id(m)]
+        return out
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         return self.forward(*args, **kwargs)
 
     def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
+        self._ensure_groups()
+        handled: set = set()
         for m in self.values():
-            m.update(*args, **m._filter_kwargs(**kwargs))
+            if id(m) in handled:
+                continue
+            g = m._compute_group
+            if g is None:
+                m.update(*args, **m._filtered_kwargs(kwargs))
+            else:
+                handled.update(id(p) for p in g.members)
+                self._group_update(g, m, args, kwargs)
+
+    def _group_update(
+        self, group: _ComputeGroup, source: Metric, args: Tuple, kwargs: Dict[str, Any]
+    ) -> None:
+        """One update for the whole group: ``source`` (the group's first
+        member in collection order) runs it, siblings re-alias its result."""
+        if any(p._is_synced for p in group.members if p is not source):
+            raise MetricsTPUUserError(
+                "The Metric shouldn't be synced when performing ``update``. "
+                "HINT: Did you forget to call ``unsync``?"
+            )
+        group.dispatching = True
+        try:
+            source.update(*args, **source._filtered_kwargs(kwargs))
+        except BaseException:
+            # the update failed mid-mutation: disband the group so the next
+            # dispatch cannot re-link siblings onto the partial state
+            self._break_group(group)
+            raise
+        finally:
+            group.dispatching = False
+        for p in group.members:
+            if p is source:
+                continue
+            p._computed = None
+            p._update_called = True
+            p._update_count = source._update_count
+        self._relink_group(group, source)
+
+    def _group_forward(
+        self, group: _ComputeGroup, source: Metric, args: Tuple, kwargs: Dict[str, Any]
+    ) -> Dict[int, Any]:
+        """Group-level ``forward``: one update on a fresh batch state, then
+        every member computes ITS batch value from the shared batch state,
+        then one merge back into the shared accumulation — the single-update
+        forward of ``Metric.forward``, paid once per group instead of once
+        per member. Returns ``{id(member): batch_value}``.
+        """
+        if any(p._is_synced for p in group.members):
+            raise MetricsTPUUserError(
+                "The Metric shouldn't be synced when performing ``update``. "
+                "HINT: Did you forget to call ``unsync``?"
+            )
+        if all(not p.compute_on_step for p in group.members):
+            self._group_update(group, source, args, kwargs)
+            return {id(p): None for p in group.members}
+        accumulated = {k: _copy_state_value(v) for k, v in source._state.items()}
+        can_merge = source._can_merge()
+        try:
+            source._restore(source._batch_default_state())
+            group.dispatching = True
+            try:
+                source.update(*args, **source._filtered_kwargs(kwargs))
+            finally:
+                group.dispatching = False
+            for p in group.members:
+                if p is not source:
+                    p._update_called = True
+                    p._computed = None
+            self._relink_group(group, source)  # members see the batch state
+            values: Dict[int, Any] = {}
+            for p in group.members:
+                if not p.compute_on_step:
+                    values[id(p)] = None
+                    continue
+                p._to_sync = p.dist_sync_on_step
+                p._computed = None
+                try:
+                    p._forward_cache = p.compute()
+                finally:
+                    p._to_sync = True
+                p._computed = None
+                values[id(p)] = p._forward_cache
+            batch_state = {k: _copy_state_value(v) for k, v in source._state.items()}
+            if can_merge:
+                source._restore(source.merge_states(accumulated, batch_state))
+            else:
+                # non-mergeable state: replay the reference's double-update path
+                source._restore(accumulated)
+                group.dispatching = True
+                try:
+                    source.update(*args, **source._filtered_kwargs(kwargs))
+                finally:
+                    group.dispatching = False
+        except BaseException:
+            # a failed forward leaves the mid-dispatch member on whatever
+            # partial state the failure produced (ungrouped semantics);
+            # disband the group so no later re-link clobbers the siblings
+            self._break_group(group)
+            raise
+        for p in group.members:
+            if p is not source:
+                p._update_count = source._update_count
+        self._relink_group(group, source)
+        return values
 
     def compute(self) -> Dict[str, Any]:
         return {self._set_name(k): m.compute() for k, m in super().items()}
 
     def reset(self) -> None:
-        for m in self.values():
-            m.reset()
+        groups = list(self._iter_group_objects())
+        for g in groups:
+            g.dispatching = True
+        try:
+            for m in self.values():
+                m.reset()
+        finally:
+            for g in groups:
+                g.dispatching = False
+                self._relink_group(g)
+        # every member is back on its defaults: re-plan at the next dispatch
+        # so members that had copy-on-write detached can rejoin their group
+        self._groups_stale = True
 
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)
@@ -166,14 +647,24 @@ class MetricCollection(dict):
             m.persistent(mode)
 
     def state_dict(self) -> Dict[str, Any]:
+        """Full per-member snapshot — group members each serialize the shared
+        state under their own prefix, so the checkpoint loads identically
+        into a grouped OR ungrouped (``METRICS_TPU_COMPUTE_GROUPS=0``)
+        collection."""
         out: Dict[str, Any] = {}
         for k, m in super().items():
             out.update(m.state_dict(prefix=f"{k}."))
         return out
 
     def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+        """Per-member load. Members leave their compute groups while loading
+        (each may be handed divergent state); the partition is re-planned at
+        the next dispatch, re-grouping exactly the members whose loaded
+        states are bit-equal."""
         for k, m in super().items():
             m.load_state_dict(state_dict, prefix=f"{k}.")
+        self._groups_planned = False
+        self._groups_stale = True
 
     # ---------------- host sync (fault-tolerance aware) ----------------
 
@@ -191,8 +682,22 @@ class MetricCollection(dict):
         states combine into one key-prefixed dict and sync through a single
         bucketed plan (``parallel/bucketing.py``) — one health header plus
         one collective per dtype/fx class for the WHOLE collection, instead
-        of O(#metrics × #leaves). ``METRICS_TPU_FUSED_SYNC=0`` (or any
-        member's ``sync_fused=False``) restores the per-member loop.
+        of O(#metrics × #leaves). Compute groups shrink the plan further:
+        only one member per group contributes its (shared) state, so the
+        header's count/length columns and the collective payloads scale
+        with the number of *unique* states, not members.
+        ``METRICS_TPU_FUSED_SYNC=0`` (or any member's ``sync_fused=False``)
+        restores the per-member loop, which deliberately syncs EVERY member
+        — including group siblings — one at a time: each member gathers its
+        own (pre-sync, still-aliased) local state, so values are identical,
+        and the collective count per rank stays a function of the member
+        count alone. Deduping here would make the collective schedule
+        depend on the group partition, which depends on state bytes — and
+        a rank whose members diverged out-of-band (direct updates,
+        per-rank checkpoints) would then issue fewer collectives than its
+        peers and desynchronize the channel. The fused path CAN dedupe
+        safely because its one combined header verifies the (partition-
+        dependent) key set across ranks before any payload moves.
 
         Failure semantics are preserved from the per-member protocol:
 
@@ -203,12 +708,15 @@ class MetricCollection(dict):
         - under ``"local"``/``"warn"`` a failed fused sync falls back to the
           per-member loop so each member degrades *independently* — healthy
           members still report global values while sick ones keep local
-          state (``Metric.sync`` swallows the error per member).
+          state (``Metric.sync`` swallows the error per member); a degraded
+          group keeps its shared views intact (state is untouched) and every
+          sibling is marked degraded together.
         """
         if on_error is not None and on_error not in _ON_ERROR_MODES:
             raise MetricsTPUUserError(
                 f"`on_error` must be one of {_ON_ERROR_MODES}, got {on_error!r}"
             )
+        self._ensure_groups()
         if should_sync and dist_sync_fn is None and self._fused_sync_eligible(distributed_available):
             try:
                 self._sync_fused(timeout=timeout)
@@ -224,6 +732,11 @@ class MetricCollection(dict):
                 # applies its own on_error (healthy members still get global
                 # values; the verify outcome is identical on every rank, so
                 # all ranks fall back together and collectives stay aligned)
+        # per-member loop: every member syncs itself, grouped or not. A
+        # synced member _restores gathered COPIES into its own dict, so a
+        # later sibling still gathers the group's pre-sync local values —
+        # no double counting — and the collective count per rank never
+        # depends on the (state-dependent) group partition.
         synced: List[Metric] = []
         try:
             for m in self.values():
@@ -281,11 +794,28 @@ class MetricCollection(dict):
                 return False
         return True
 
+    def _sync_state_owners(self) -> List[Tuple[str, Metric, List[Metric]]]:
+        """One ``(key, metric, group_siblings)`` triple per *unique* state:
+        compute-group siblings share their representative's gathered result
+        instead of contributing duplicate payloads."""
+        owners: List[Tuple[str, Metric, List[Metric]]] = []
+        seen_groups: set = set()
+        for key, m in super().items():
+            g = m._compute_group
+            if g is None:
+                owners.append((key, m, []))
+            elif id(g) not in seen_groups:
+                seen_groups.add(id(g))
+                owners.append((key, m, [p for p in g.members if p is not m]))
+        return owners
+
     def _sync_fused(self, timeout: Optional[float] = None) -> None:
-        """One bucketed plan over every member's states.
+        """One bucketed plan over every *unique* member state (compute-group
+        siblings dedupe to one payload; the header's count/length columns
+        shrink accordingly).
 
         The combined header's ``update_count`` column carries the SUM of
-        member counts — a best-effort skew indicator only (opposite-
+        unique-state counts — a best-effort skew indicator only (opposite-
         direction member skews can cancel), which is why strict-mode
         members are excluded from fused eligibility and keep the exact
         per-member check. Raises the typed ``SyncError`` before any member
@@ -293,15 +823,15 @@ class MetricCollection(dict):
         """
         from metrics_tpu.parallel.sync import host_sync_state
 
-        members = list(super().items())
+        owners = self._sync_state_owners()
         combined: Dict[str, Any] = {}
         reductions: Dict[str, Any] = {}
-        for key, m in members:
+        for key, m, _peers in owners:
             for name, value in m._state.items():
                 combined[f"{key}{_FUSED_KEY_SEP}{name}"] = value
                 reductions[f"{key}{_FUSED_KEY_SEP}{name}"] = m._reductions.get(name)
         member_timeouts = [
-            t for _, m in members if (t := getattr(m, "sync_timeout", None)) is not None
+            t for m in self.values() if (t := getattr(m, "sync_timeout", None)) is not None
         ]
         effective_timeout = timeout if timeout is not None else (
             min(member_timeouts) if member_timeouts else None
@@ -309,30 +839,40 @@ class MetricCollection(dict):
         synced = host_sync_state(
             combined,
             reductions,
-            update_count=sum(getattr(m, "_update_count", 0) for _, m in members),
+            update_count=sum(getattr(m, "_update_count", 0) for _, m, _p in owners),
             timeout=effective_timeout,
-            metric_name=f"MetricCollection[{', '.join(k for k, _ in members)}]",
+            metric_name=f"MetricCollection[{', '.join(self.keys())}]",
             fused=True,
         )
-        # snapshot each member's pre-sync state only now: the sync never
+        # snapshot each owner's pre-sync state only now: the sync never
         # mutates its inputs, and a failed attempt (the common case the
         # on_error fallback exists for) must not pay for full state copies
-        for key, m in members:
+        for key, m, peers in owners:
             m._cache = {k: _copy_state_value(v) for k, v in m._state.items()}
             m._sync_degraded = False
             m._restore({name: synced[f"{key}{_FUSED_KEY_SEP}{name}"] for name in m._state})
             m._is_synced = True
+            for p in peers:
+                p._cache = {k: _copy_state_value(v) for k, v in m._cache.items()}
+                p._sync_degraded = False
+                for name in m._state:
+                    p._state[name] = m._state[name]
+                p._is_synced = True
 
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore every synced member's pre-sync local state.
 
         Members that degraded to local-only state (``on_error="local"``)
-        were never marked synced and are skipped rather than raising."""
+        were never marked synced and are skipped rather than raising.
+        Compute-group views are re-linked afterwards (each member restored
+        an equal-valued copy; re-aliasing keeps the one-copy-of-state
+        invariant)."""
         if not should_unsync:
             return
         for m in self.values():
             if m._is_synced:
                 m.unsync()
+        self._relink_groups()
 
     @contextmanager
     def sync_context(
@@ -361,13 +901,48 @@ class MetricCollection(dict):
     # ---------------- pure-functional fused path ----------------
 
     def init_state(self) -> Dict[str, Dict[str, Any]]:
+        # every member gets distinct fresh buffers (donation safety — see
+        # Metric._default_state); compute-group dedup happens in pure_update,
+        # whose outputs alias one subtree per group
         return {k: m.init_state() for k, m in super().items()}
 
+    def _map_members_deduped(self, fn: Callable[[str, Metric], Any]) -> Dict[str, Any]:
+        """Apply ``fn(key, member)`` per member with compute-group dedup: the
+        group's first member in collection order runs it once and the result
+        is aliased to every sibling key. Shared scaffolding of
+        ``pure_update``/``pure_sync``/``merge_states``."""
+        self._ensure_groups()
+        out: Dict[str, Any] = {}
+        group_results: Dict[int, Any] = {}
+        for k, m in super().items():
+            g = m._compute_group
+            if g is not None and id(g) in group_results:
+                out[k] = group_results[id(g)]
+                continue
+            result = fn(k, m)
+            if g is not None:
+                group_results[id(g)] = result
+            out[k] = result
+        return out
+
     def pure_update(self, state: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        return {
-            k: m.pure_update(state[k], *args, **m._filter_kwargs(**kwargs))
-            for k, m in super().items()
-        }
+        """Pure functional update of every member's state subtree.
+
+        Compute groups pay once: the group's first member traces ONE update
+        over its subtree and the result is aliased to every sibling key —
+        under jit the duplicate subtrees are the same tracers, so XLA emits
+        a single update computation for the whole group.
+
+        Caller contract for grouped collections: thread the WHOLE state
+        through the collection-level ``pure_*`` methods. A group reads only
+        its first member's subtree, so a sibling subtree mutated out of
+        band (e.g. an extra per-member ``pure_update``) is superseded by
+        the group result — the pure API has no per-call divergence
+        detection (states may be tracers). For per-member divergence on
+        the pure path, construct with ``compute_groups=False``."""
+        return self._map_members_deduped(
+            lambda k, m: m.pure_update(state[k], *args, **m._filtered_kwargs(kwargs))
+        )
 
     def pure_sync(
         self, state: Dict[str, Any], axis_name: Optional[Any] = None, fused: bool = False
@@ -379,24 +954,28 @@ class MetricCollection(dict):
         their standalone ``pure_forward`` would do). Raises if no member
         declares a group — there would be nothing to sync. ``fused=True``
         buckets each member's same-dtype/same-fx reduce leaves into one
-        collective op (``sync_in_jit`` fused mode)."""
-        if axis_name is not None:
-            return {k: m.pure_sync(state[k], axis_name, fused=fused) for k, m in super().items()}
-        if all(m.process_group is None for m in super().values()):
+        collective op (``sync_in_jit`` fused mode). Compute groups issue
+        their collectives once and alias the result to every sibling key."""
+        if axis_name is None and all(m.process_group is None for m in super().values()):
             raise MetricsTPUUserError(
                 "pure_sync needs a mesh axis: pass `axis_name=` or construct "
                 "at least one member with `process_group=<axis or tuple>`."
             )
-        return {
-            k: m.pure_sync(state[k], fused=fused) if m.process_group is not None else state[k]
-            for k, m in super().items()
-        }
+
+        def sync_one(k: str, m: Metric) -> Any:
+            if axis_name is not None:
+                return m.pure_sync(state[k], axis_name, fused=fused)
+            if m.process_group is not None:
+                return m.pure_sync(state[k], fused=fused)
+            return state[k]
+
+        return self._map_members_deduped(sync_one)
 
     def pure_compute(self, state: Dict[str, Any]) -> Dict[str, Any]:
         return {self._set_name(k): m.pure_compute(state[k]) for k, m in super().items()}
 
     def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
-        return {k: m.merge_states(a[k], b[k]) for k, m in super().items()}
+        return self._map_members_deduped(lambda k, m: m.merge_states(a[k], b[k]))
 
     def pure_forward(
         self, state: Dict[str, Any], *args: Any, axis_name: Optional[str] = None, **kwargs: Any

@@ -26,7 +26,7 @@ re-designed around JAX's functional model:
 import functools
 import warnings
 from copy import deepcopy
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -150,6 +150,24 @@ def _copy_state_value(v: Any) -> Any:
     return v
 
 
+class _ComputeGroup:
+    """Shared-state link between metrics of a ``MetricCollection`` compute
+    group (see ``collections.py``): every member's ``_state`` values alias
+    the same underlying arrays/containers, so the group pays for ONE update
+    and ONE copy of state. ``members[0]`` is the default dispatch source for
+    re-linking; ``dispatching`` is True only while the owning collection is
+    driving a group-level operation (update/forward/reset), which is what
+    distinguishes a sanctioned shared-state mutation from a stray
+    out-of-group call that must copy-on-write detach first.
+    """
+
+    __slots__ = ("members", "dispatching")
+
+    def __init__(self, members: List["Metric"]) -> None:
+        self.members = members
+        self.dispatching = False
+
+
 def _fresh_state_value(v: Any) -> Any:
     """A deep, newly-allocated copy of a state default (see _default_state)."""
     if isinstance(v, list):
@@ -260,6 +278,19 @@ class Metric:
     #: bit-identical either way (``parallel/bucketing.py``).
     sync_fused: Optional[bool] = None
 
+    #: Compute-group link (set by ``MetricCollection`` when this metric is
+    #: grouped with schema/update-identical siblings; ``None`` = ungrouped).
+    _compute_group: Optional[_ComputeGroup] = None
+
+    #: Instance attributes a grouped update writes as side effects (e.g. an
+    #: inferred ``num_classes`` or input-mode latch). After each group
+    #: dispatch the collection copies these from the member that ran the
+    #: update to every other member, so compute() on a non-dispatched member
+    #: sees exactly what its own update would have inferred. Families that
+    #: declare an ``update_identity`` and mutate instance attrs in
+    #: ``update`` MUST list them here.
+    _group_shared_attrs: Tuple[str, ...] = ()
+
     def __init__(
         self,
         compute_on_step: bool = True,
@@ -350,6 +381,7 @@ class Metric:
         ``update``/``compute`` code is unchanged — ``.append`` and
         ``dim_zero_cat`` dispatch on the state type. Returns ``self``.
         """
+        self._group_detach_if_stray()
         for name, default in self._defaults.items():
             if isinstance(default, list):
                 if default or (isinstance(self._state.get(name), list) and self._state[name]):
@@ -398,9 +430,102 @@ class Metric:
                     "enable_check_finite() must be called before the first "
                     "update() — the poison flag must cover the whole accumulation."
                 )
+            self._group_detach_if_stray()  # schema change: leave the group
             self.add_state(NONFINITE_STATE, jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
         self.check_finite = True
         return self
+
+    # ------------------------------------------------------------------
+    # compute-group protocol (MetricCollection state/update dedup)
+    # ------------------------------------------------------------------
+
+    def state_fingerprint(self) -> Tuple:
+        """Deterministic fingerprint of the declared state schema.
+
+        Covers every ``add_state`` declaration: name, kind (array / list /
+        CatBuffer), dtype, shape, reduction, and the reset default's exact
+        bytes. Two metrics with equal fingerprints own interchangeable
+        state pytrees — the first of the two conditions
+        ``MetricCollection`` requires before putting them in one compute
+        group (the second is an equal :meth:`update_identity`).
+        """
+        parts: List[Tuple] = []
+        for name in sorted(self._defaults):
+            default = self._defaults[name]
+            fx = self._reductions[name]
+            # callables compare by object identity: two different functions
+            # may reduce differently even when their names collide
+            fx_tag: Any = ("callable", id(fx)) if callable(fx) and not isinstance(fx, str) else fx
+            if isinstance(default, list):
+                parts.append((name, "list", fx_tag))
+            elif isinstance(default, CatBuffer):
+                item = (
+                    None
+                    if default.buffer is None
+                    else (str(default.buffer.dtype), tuple(default.buffer.shape[1:]))
+                )
+                parts.append((name, "catbuf", default.capacity, item, fx_tag))
+            else:
+                arr = np.asarray(default)
+                parts.append((name, "leaf", str(arr.dtype), tuple(arr.shape), arr.tobytes(), fx_tag))
+        return tuple(parts)
+
+    def update_identity(self) -> Optional[Tuple]:
+        """Hashable key identifying what this metric's ``update`` *does* to
+        its state, or ``None`` (the default) when the metric makes no such
+        claim and must never share updates.
+
+        Metric families whose members run provably identical updates — the
+        same ``update`` code path with the same configuration — declare a
+        key here (e.g. all ``StatScores``-backed classification metrics
+        with equal ``(reduce, threshold, num_classes, ...)`` args, or the
+        curve metrics sharing ``_precision_recall_curve_update``). Two
+        collection members with equal keys AND equal
+        :meth:`state_fingerprint` form a compute group: one update, one
+        copy of state. Declaring a key is a *correctness promise*; a family
+        whose update mutates instance attributes must also list them in
+        ``_group_shared_attrs``.
+        """
+        return None
+
+    def _effective_update_identity(self) -> Optional[Tuple]:
+        """The identity key, guarded against inherited-declaration bugs: a
+        subclass that overrides ``update`` without re-declaring
+        ``update_identity`` gets ``None`` (the inherited key describes the
+        base class's update, not the override)."""
+        cls = type(self)
+        ident_cls = next(c for c in cls.__mro__ if "update_identity" in c.__dict__)
+        if ident_cls is Metric:
+            return None
+        upd_cls = next((c for c in cls.__mro__ if "update" in c.__dict__), None)
+        if upd_cls is not None and cls.__mro__.index(upd_cls) < cls.__mro__.index(ident_cls):
+            return None
+        return self.update_identity()
+
+    def _group_detach_if_stray(self) -> None:
+        """Copy-on-write detach from a compute group on an out-of-group
+        state mutation (direct ``update``/``reset``/``load_state_dict``/
+        dtype-or-capacity change on one member): the member takes private
+        copies of the shared containers and leaves the group, so its
+        divergence never corrupts its former siblings. Group-dispatched
+        operations (``dispatching`` set by the collection) pass through.
+        """
+        group = self._compute_group
+        if group is None or group.dispatching:
+            return
+        if self.__dict__.get("_pure_mode", False):
+            # pure_update/pure_compute operate on an explicit state copy and
+            # restore the instance state afterwards — nothing shared mutates
+            return
+        group.members[:] = [m for m in group.members if m is not self]
+        object.__setattr__(self, "_compute_group", None)
+        # private copies of mutable containers; array leaves are immutable
+        # and stay shared until the next reassignment (true copy-on-write)
+        self._state = {k: _copy_state_value(v) for k, v in self._state.items()}
+        if len(group.members) < 2:
+            for m in group.members:
+                object.__setattr__(m, "_compute_group", None)
+            group.members.clear()
 
     def __getattr__(self, name: str) -> Any:
         # only called when normal lookup fails
@@ -412,6 +537,13 @@ class Metric:
     def __setattr__(self, name: str, value: Any) -> None:
         state = self.__dict__.get("_state")
         if state is not None and name in state:
+            if self.__dict__.get("_compute_group") is not None:
+                # direct state assignment on a grouped member (m.tp = ...)
+                # is an out-of-group mutation like a stray update(): leave
+                # the group first, or the next group dispatch would silently
+                # revert it when re-linking the shared views
+                self._group_detach_if_stray()
+                state = self.__dict__["_state"]  # detach swaps the dict
             state[name] = value
         else:
             object.__setattr__(self, name, value)
@@ -683,12 +815,15 @@ class Metric:
         fixed-shape (non-list) states."""
         saved = self._state
         saved_count = getattr(self, "_update_count", 0)
+        saved_pure = self.__dict__.get("_pure_mode", False)
         self._state = {k: _copy_state_value(v) for k, v in state.items()}
+        object.__setattr__(self, "_pure_mode", True)
         try:
             self.update(*args, **kwargs)
             return self._state
         finally:
             self._state = saved
+            object.__setattr__(self, "_pure_mode", saved_pure)
             # the counter rides the health word for the STATEFUL accumulation;
             # a pure update operates on an explicit state pytree (warm-ups,
             # scan carries) and must not skew it across ranks
@@ -802,6 +937,7 @@ class Metric:
 
     def merge_state(self, incoming: Union["Metric", Dict[str, Any]]) -> None:
         """Merge another metric's (or raw state dict's) accumulation into self."""
+        self._group_detach_if_stray()
         other = incoming._state if isinstance(incoming, Metric) else incoming
         self._restore(self.merge_states(self._state, other))
 
@@ -829,6 +965,7 @@ class Metric:
 
     def reset(self) -> None:
         """Reset state to defaults (reference ``metric.py:381-398``)."""
+        self._group_detach_if_stray()
         self._update_called = False
         self._update_count = 0
         self._forward_cache = None
@@ -878,6 +1015,7 @@ class Metric:
         return out
 
     def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
+        self._group_detach_if_stray()
         for name in self._defaults:
             key = prefix + name
             if key in state_dict:
@@ -931,6 +1069,7 @@ class Metric:
 
     def to_device(self, device: Any) -> "Metric":
         """Move all array state to ``device`` (analogue of ``.to()``)."""
+        self._group_detach_if_stray()
         self._restore(
             apply_to_collection(self._state, (jnp.ndarray,), lambda x: jax.device_put(x, device))
         )
@@ -996,6 +1135,7 @@ class Metric:
 
         numpy leaves are cast too: materialized CatBuffer defaults are numpy
         (tracer-safe), and missing them would revert the cast on reset."""
+        self._group_detach_if_stray()
         self._dtype = dtype
         self._restore(_cast_floating(self._state, dtype))
         self._defaults = _cast_floating(self._defaults, dtype)
@@ -1031,13 +1171,10 @@ class Metric:
                 hash_vals.append(np.asarray(v).tobytes())
         return hash(tuple(hash_vals))
 
-    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
-        """Keep only kwargs accepted by this metric's ``update`` signature.
-
-        Analogue of reference ``metric.py:583-604``; lets ``MetricCollection``
-        broadcast a superset of kwargs to heterogeneous metrics. The signature
-        is inspected once per instance (hot path: every collection step).
-        """
+    def _update_kwarg_filter(self) -> Union[bool, frozenset]:
+        """The cached accepted-kwarg set of this metric's ``update`` signature
+        (``True`` = accepts ``**kwargs``). Inspected once per instance — the
+        collection hot path never touches ``inspect`` again."""
         names = self.__dict__.get("_update_kwarg_names")
         if names is None:
             import inspect
@@ -1046,9 +1183,29 @@ class Metric:
             has_var_kw = any(p.kind == p.VAR_KEYWORD for p in params.values())
             names = True if has_var_kw else frozenset(params)
             object.__setattr__(self, "_update_kwarg_names", names)
-        if names is True:
+        return names
+
+    def _filtered_kwargs(self, kwargs: Dict[str, Any]) -> Dict[str, Any]:
+        """Like :meth:`_filter_kwargs` but takes the dict directly (no
+        ``**``-repacking) and returns it unchanged when nothing needs
+        dropping — the allocation-free fast path ``MetricCollection``'s
+        ``update``/``pure_update``/``forward`` run every step."""
+        names = self._update_kwarg_filter()
+        if names is True or not kwargs:
             return kwargs
-        return {k: v for k, v in kwargs.items() if k in names}
+        for k in kwargs:
+            if k not in names:
+                return {k2: v for k2, v in kwargs.items() if k2 in names}
+        return kwargs
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Keep only kwargs accepted by this metric's ``update`` signature.
+
+        Analogue of reference ``metric.py:583-604``; lets ``MetricCollection``
+        broadcast a superset of kwargs to heterogeneous metrics. The signature
+        is inspected once per instance (hot path: every collection step).
+        """
+        return self._filtered_kwargs(kwargs)
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}()"
@@ -1167,6 +1324,9 @@ def _wrap_update(update: Callable) -> Callable:
                 "The Metric shouldn't be synced when performing ``update``. "
                 "HINT: Did you forget to call ``unsync``?"
             )
+        # a direct update on one member of a compute group copies-on-write
+        # out of the group before mutating anything shared
+        self._group_detach_if_stray()
         self._computed = None
         self._update_called = True
         from metrics_tpu.utils.checks import _tracing_active

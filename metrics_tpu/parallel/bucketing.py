@@ -13,7 +13,12 @@ to move the same bytes in O(#dtypes × #fx-classes) collectives.
 This module is the **bucketed sync planner**. Given the state dict of one
 metric — or the combined, key-prefixed states of an entire
 ``MetricCollection`` (``MetricCollection.sync``) — it classifies every leaf
-and builds a :class:`SyncPlan`:
+and builds a :class:`SyncPlan`. Compute groups (``core/collections.py``)
+compose with the planner upstream: the collection combines ONE state per
+group (not one per member), so a grouped collection's plan carries fewer
+leaves — fewer header count/length columns consumed and strictly smaller
+bucket payloads — while staying rank-symmetric (grouping is deterministic
+from construction, so every rank plans the identical combined schema):
 
 - **reduce leaves** (``fx`` in ``sum``/``mean``/``max``/``min``) group by
   ``(dtype, fx)``: each bucket flattens and concatenates into one flat

@@ -17,7 +17,10 @@ machinery (``metric.py:217-242``). Two paths:
   fused path** (``parallel/bucketing.py``): one collective per dtype/fx
   class for the whole state (or a whole ``MetricCollection``), with per-rank
   lengths riding the header instead of per-leaf shape gathers
-  (``METRICS_TPU_FUSED_SYNC=0`` restores the per-leaf path).
+  (``METRICS_TPU_FUSED_SYNC=0`` restores the per-leaf path). A collection's
+  compute groups (``core/collections.py``) dedupe the combined payload one
+  layer up: one gathered state per group of schema/update-identical members,
+  so the bytes a grouped collection moves scale with its *unique* states.
 """
 from typing import Any, Callable, Dict, List, Optional, Union
 

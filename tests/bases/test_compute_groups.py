@@ -1,0 +1,543 @@
+"""Compute-group planner tests (ISSUE 3 tentpole).
+
+The contract: members of a ``MetricCollection`` whose state schema
+(``state_fingerprint``) and update (``update_identity``) are provably
+identical run ONE update per step and hold ONE copy of state (siblings alias
+the same arrays/containers), with every observable result — ``compute``,
+``forward``, ``pure_*``, ``state_dict`` — bit-identical to the ungrouped
+collection. Divergence (a direct out-of-group ``update``/``reset``/
+``load_state_dict`` on one member) copies-on-write out of the group.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.classification.stat_scores as stat_scores_mod
+from metrics_tpu import (
+    Accuracy,
+    AUROC,
+    AveragePrecision,
+    MetricCollection,
+    Precision,
+    PrecisionRecallCurve,
+    Recall,
+    ROC,
+    Specificity,
+)
+from metrics_tpu import F1
+from metrics_tpu.core.cat_buffer import CatBuffer
+from metrics_tpu.core.collections import COMPUTE_GROUPS_ENV, compute_groups_enabled
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+rng = np.random.RandomState(7)
+PREDS = [jnp.asarray(rng.rand(48, 5).astype(np.float32)) for _ in range(3)]
+TARGET = [jnp.asarray(rng.randint(0, 5, (48,))) for _ in range(3)]
+BPREDS = [jnp.asarray(rng.rand(40).astype(np.float32)) for _ in range(3)]
+BTARGET = [jnp.asarray(rng.randint(0, 2, (40,)).astype(np.int32)) for _ in range(3)]
+
+
+def _stat_collection(**kwargs):
+    return MetricCollection(
+        {
+            "prec": Precision(num_classes=5, average="macro"),
+            "rec": Recall(num_classes=5, average="macro"),
+            "f1": F1(num_classes=5, average="macro"),
+            "spec": Specificity(num_classes=5, average="macro"),
+        },
+        **kwargs,
+    )
+
+
+def _curve_collection(**kwargs):
+    return MetricCollection(
+        {
+            "roc": ROC(pos_label=1),
+            "prc": PrecisionRecallCurve(pos_label=1),
+            "ap": AveragePrecision(pos_label=1),
+        },
+        **kwargs,
+    )
+
+
+def _values(out):
+    return {k: np.asarray(v) for k, v in out.items() if not isinstance(v, (tuple, list))}
+
+
+def _assert_tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype
+        assert x.shape == y.shape
+        assert x.tobytes() == y.tobytes()
+
+
+# ---------------------------------------------------------------------------
+# group formation
+# ---------------------------------------------------------------------------
+
+
+def test_stat_score_family_groups():
+    mc = _stat_collection()
+    mc.update(PREDS[0], TARGET[0])
+    assert mc.compute_group_keys == [["f1", "prec", "rec", "spec"]]
+    for name in ("tp", "fp", "tn", "fn"):
+        assert mc["prec"]._state[name] is mc["rec"]._state[name]
+        assert mc["prec"]._state[name] is mc["f1"]._state[name]
+        assert mc["prec"]._state[name] is mc["spec"]._state[name]
+
+
+def test_one_update_dispatch_per_group(monkeypatch):
+    calls = {"n": 0}
+    orig = stat_scores_mod._stat_scores_update
+
+    def counting(*args, **kwargs):
+        calls["n"] += 1
+        return orig(*args, **kwargs)
+
+    monkeypatch.setattr(stat_scores_mod, "_stat_scores_update", counting)
+    mc = _stat_collection()
+    mc.update(PREDS[0], TARGET[0])
+    assert calls["n"] == 1
+    calls["n"] = 0
+    ungrouped = _stat_collection(compute_groups=False)
+    ungrouped.update(PREDS[0], TARGET[0])
+    assert calls["n"] == 4
+
+
+def test_accuracy_never_groups_with_stat_scores():
+    """Accuracy overrides the family update (mode latch + subset branch +
+    extra states); the MRO guard keeps the inherited identity from lying."""
+    mc = MetricCollection(
+        {
+            "acc": Accuracy(num_classes=5, average="macro", mdmc_average=None),
+            "prec": Precision(num_classes=5, average="macro"),
+            "rec": Recall(num_classes=5, average="macro"),
+        }
+    )
+    mc.update(PREDS[0], TARGET[0])
+    assert mc.compute_group_keys == [["prec", "rec"]]
+    assert mc["acc"]._compute_group is None
+
+
+def test_accuracy_groups_with_equal_accuracy():
+    mc = MetricCollection(
+        {"a1": Accuracy(num_classes=5), "a2": Accuracy(num_classes=5)}
+    )
+    mc.update(PREDS[0], TARGET[0])
+    assert mc.compute_group_keys == [["a1", "a2"]]
+    # the mode latch (an update side effect) propagates to the sibling
+    assert mc["a2"].mode is not None and mc["a2"].mode == mc["a1"].mode
+    ungrouped = MetricCollection(
+        {"a1": Accuracy(num_classes=5), "a2": Accuracy(num_classes=5)},
+        compute_groups=False,
+    )
+    ungrouped.update(PREDS[0], TARGET[0])
+    _assert_tree_equal(mc.compute(), ungrouped.compute())
+
+
+def test_differing_args_do_not_group():
+    mc = MetricCollection(
+        {
+            "p_macro": Precision(num_classes=5, average="macro"),
+            "p_micro": Precision(average="micro"),
+            "r_macro": Recall(num_classes=5, average="macro"),
+        }
+    )
+    mc.update(PREDS[0], TARGET[0])
+    assert mc.compute_group_keys == [["p_macro", "r_macro"]]
+
+
+def test_curve_family_shares_one_accumulation():
+    mc = _curve_collection()
+    for p, t in zip(BPREDS, BTARGET):
+        mc.update(p, t)
+    assert mc.compute_group_keys == [["ap", "prc", "roc"]]
+    assert mc["roc"]._state["preds"] is mc["prc"]._state["preds"]
+    assert mc["roc"]._state["target"] is mc["ap"]._state["target"]
+    ungrouped = _curve_collection(compute_groups=False)
+    for p, t in zip(BPREDS, BTARGET):
+        ungrouped.update(p, t)
+    _assert_tree_equal(mc.compute(), ungrouped.compute())
+
+
+def test_curve_family_with_capacity_shares_one_catbuffer():
+    mc = MetricCollection(
+        {
+            "roc": ROC(pos_label=1).with_capacity(256),
+            "prc": PrecisionRecallCurve(pos_label=1).with_capacity(256),
+            "ap": AveragePrecision(pos_label=1).with_capacity(256),
+        }
+    )
+    for p, t in zip(BPREDS, BTARGET):
+        mc.update(p, t)
+    assert mc.compute_group_keys == [["ap", "prc", "roc"]]
+    assert isinstance(mc["roc"]._state["preds"], CatBuffer)
+    assert mc["roc"]._state["preds"] is mc["prc"]._state["preds"]
+    assert mc["roc"]._state["preds"] is mc["ap"]._state["preds"]
+    assert len(mc["roc"]._state["preds"]) == sum(len(p) for p in BPREDS)
+
+
+def test_catbuffer_group_survives_reset():
+    """An update materializes the dispatching member's CatBuffer DEFAULT
+    (item spec fixed); the relink propagates it to siblings so fingerprints
+    stay equal and the group re-forms after reset instead of dissolving."""
+    mc = MetricCollection(
+        {
+            "roc": ROC(pos_label=1).with_capacity(256),
+            "prc": PrecisionRecallCurve(pos_label=1).with_capacity(256),
+        }
+    )
+    mc.update(BPREDS[0], BTARGET[0])
+    assert mc.compute_group_keys == [["prc", "roc"]]
+    mc.reset()
+    mc.update(BPREDS[1], BTARGET[1])
+    assert mc.compute_group_keys == [["prc", "roc"]]
+    assert mc["roc"]._state["preds"] is mc["prc"]._state["preds"]
+
+
+def test_auroc_groups_within_class_only():
+    mc = MetricCollection(
+        {
+            "auroc": AUROC(),
+            "auroc2": AUROC(),
+            "roc": ROC(pos_label=1),
+        }
+    )
+    mc.update(BPREDS[0], BTARGET[0])
+    assert mc.compute_group_keys == [["auroc", "auroc2"]]
+    assert mc["auroc2"].mode == mc["auroc"].mode
+
+
+def test_mixed_capacity_does_not_group():
+    mc = MetricCollection(
+        {"roc": ROC(pos_label=1).with_capacity(128), "prc": PrecisionRecallCurve(pos_label=1)}
+    )
+    mc.update(BPREDS[0], BTARGET[0])
+    assert mc.compute_group_keys == []
+
+
+def test_env_escape_hatch(monkeypatch):
+    monkeypatch.setenv(COMPUTE_GROUPS_ENV, "0")
+    assert not compute_groups_enabled()
+    mc = _stat_collection()
+    mc.update(PREDS[0], TARGET[0])
+    assert mc.compute_group_keys == []
+    assert mc["prec"]._state["tp"] is not mc["rec"]._state["tp"]
+    ungrouped = _stat_collection(compute_groups=False)
+    ungrouped.update(PREDS[0], TARGET[0])
+    _assert_tree_equal(mc.compute(), ungrouped.compute())
+
+
+def test_explicit_override_groups_and_validates():
+    mc = _stat_collection(compute_groups=[["prec", "rec"]])
+    mc.update(PREDS[0], TARGET[0])
+    assert mc.compute_group_keys == [["prec", "rec"]]
+    assert mc["f1"]._compute_group is None
+    with pytest.raises(MetricsTPUUserError, match="unknown metric"):
+        _stat_collection(compute_groups=[["prec", "nope"]]).update(PREDS[0], TARGET[0])
+    with pytest.raises(MetricsTPUUserError, match="more than one group"):
+        _stat_collection(compute_groups=[["prec", "rec"], ["prec", "f1"]]).update(
+            PREDS[0], TARGET[0]
+        )
+    with pytest.raises(MetricsTPUUserError, match="different state schema"):
+        MetricCollection(
+            {"prec": Precision(num_classes=5, average="macro"), "auroc": AUROC()},
+            compute_groups=[["prec", "auroc"]],
+        ).update(PREDS[0], TARGET[0])
+
+
+def test_pre_diverged_member_stays_solo():
+    prec = Precision(num_classes=5, average="macro")
+    prec.update(PREDS[1], TARGET[1])  # out-of-band history
+    mc = MetricCollection(
+        {"prec": prec, "rec": Recall(num_classes=5, average="macro")}
+    )
+    mc.update(PREDS[0], TARGET[0])
+    assert mc.compute_group_keys == []
+    ungrouped = MetricCollection(
+        {"prec2": Precision(num_classes=5, average="macro")}, compute_groups=False
+    )
+    ungrouped.update(PREDS[1], TARGET[1])
+    ungrouped.update(PREDS[0], TARGET[0])
+    np.testing.assert_array_equal(
+        np.asarray(mc.compute()["prec"]), np.asarray(ungrouped.compute()["prec2"])
+    )
+
+
+# ---------------------------------------------------------------------------
+# bit-identical equivalence: grouped vs ungrouped, every supported family
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("family", ["stat", "curve", "curve_capacity", "accuracy"])
+def test_grouped_bit_identical_to_ungrouped(family):
+    def build(grouped):
+        if family == "stat":
+            return _stat_collection(compute_groups=grouped)
+        if family == "curve":
+            return _curve_collection(compute_groups=grouped)
+        if family == "curve_capacity":
+            return MetricCollection(
+                {
+                    "roc": ROC(pos_label=1).with_capacity(256),
+                    "ap": AveragePrecision(pos_label=1).with_capacity(256),
+                },
+                compute_groups=grouped,
+            )
+        return MetricCollection(
+            {"a1": Accuracy(num_classes=5), "a2": Accuracy(num_classes=5, top_k=2)},
+            compute_groups=grouped,
+        )
+
+    def batches(mc):
+        if family in ("stat", "accuracy"):
+            for p, t in zip(PREDS, TARGET):
+                mc.update(p, t)
+        else:
+            for p, t in zip(BPREDS, BTARGET):
+                mc.update(p, t)
+
+    grouped, ungrouped = build(True), build(False)
+    batches(grouped)
+    batches(ungrouped)
+    _assert_tree_equal(grouped.compute(), ungrouped.compute())
+    # reset and a second epoch keep the equivalence (groups survive reset)
+    grouped.reset()
+    ungrouped.reset()
+    batches(grouped)
+    batches(ungrouped)
+    _assert_tree_equal(grouped.compute(), ungrouped.compute())
+
+
+def test_forward_bit_identical_to_ungrouped():
+    grouped, ungrouped = _stat_collection(), _stat_collection(compute_groups=False)
+    for p, t in zip(PREDS, TARGET):
+        _assert_tree_equal(grouped(p, t), ungrouped(p, t))
+    _assert_tree_equal(grouped.compute(), ungrouped.compute())
+
+
+def test_pure_update_aliases_and_matches():
+    grouped, ungrouped = _stat_collection(), _stat_collection(compute_groups=False)
+    state = grouped.init_state()
+    step = jax.jit(grouped.pure_update)
+    ref_state = ungrouped.init_state()
+    for p, t in zip(PREDS, TARGET):
+        state = step(state, p, t)
+        ref_state = ungrouped.pure_update(ref_state, p, t)
+    # eager dedup: one subtree per group, aliased to every member key (jit
+    # outputs materialize distinct buffers, but trace one shared update)
+    eager = grouped.pure_update(grouped.init_state(), PREDS[0], TARGET[0])
+    assert eager["prec"]["tp"] is eager["rec"]["tp"]
+    _assert_tree_equal(grouped.pure_compute(state), ungrouped.pure_compute(ref_state))
+
+
+def test_pure_forward_matches_ungrouped():
+    grouped, ungrouped = _stat_collection(), _stat_collection(compute_groups=False)
+    sg, su = grouped.init_state(), ungrouped.init_state()
+    for p, t in zip(PREDS, TARGET):
+        sg, vg = grouped.pure_forward(sg, p, t)
+        su, vu = ungrouped.pure_forward(su, p, t)
+        _assert_tree_equal(vg, vu)
+    _assert_tree_equal(grouped.pure_compute(sg), ungrouped.pure_compute(su))
+
+
+# ---------------------------------------------------------------------------
+# copy-on-write detach
+# ---------------------------------------------------------------------------
+
+
+def test_direct_update_detaches_without_corrupting_siblings():
+    mc = _stat_collection()
+    mc.update(PREDS[0], TARGET[0])
+    before = {k: np.asarray(v) for k, v in mc.compute().items()}
+    mc["prec"].update(PREDS[1], TARGET[1])  # stray out-of-group update
+    assert mc["prec"]._compute_group is None
+    assert mc.compute_group_keys == [["f1", "rec", "spec"]]
+    after = mc.compute()
+    for key in ("rec", "f1", "spec"):
+        np.testing.assert_array_equal(before[key], np.asarray(after[key]))
+    solo = Precision(num_classes=5, average="macro")
+    solo.update(PREDS[0], TARGET[0])
+    solo.update(PREDS[1], TARGET[1])
+    np.testing.assert_array_equal(np.asarray(after["prec"]), np.asarray(solo.compute()))
+
+
+def test_direct_update_detaches_curve_member_without_shared_append():
+    mc = _curve_collection()
+    mc.update(BPREDS[0], BTARGET[0])
+    mc["ap"].update(BPREDS[1], BTARGET[1])
+    assert mc["ap"]._compute_group is None
+    # siblings kept exactly one batch; the stray append went to a private copy
+    assert len(mc["roc"]._state["preds"]) == 1
+    assert len(mc["ap"]._state["preds"]) == 2
+
+
+def test_direct_state_assignment_detaches():
+    """m.tp = ... on a grouped member is an out-of-group mutation like a
+    stray update: the member leaves the group, so the next dispatch cannot
+    silently revert the assignment by re-linking the shared views."""
+    mc = _stat_collection()
+    mc.update(PREDS[0], TARGET[0])
+    zeros = jnp.zeros_like(mc["rec"]._state["tp"])
+    mc["rec"].tp = zeros
+    assert mc["rec"]._compute_group is None
+    assert int(np.asarray(mc["prec"]._state["tp"]).sum()) > 0  # sibling intact
+    mc.update(PREDS[1], TARGET[1])
+    # the assignment survived the next dispatch (rec accumulated from zero)
+    solo = Recall(num_classes=5, average="macro")
+    solo.update(PREDS[1], TARGET[1])
+    np.testing.assert_array_equal(
+        np.asarray(mc["rec"]._state["tp"]), np.asarray(solo._state["tp"])
+    )
+
+
+def test_explicit_override_rejects_mismatched_sync_config():
+    prec = Precision(num_classes=5, average="macro")
+    prec.sync_strict_update_count = True
+    mc = MetricCollection(
+        {"prec": prec, "rec": Recall(num_classes=5, average="macro")},
+        compute_groups=[["prec", "rec"]],
+    )
+    with pytest.raises(MetricsTPUUserError, match="configured differently"):
+        mc.update(PREDS[0], TARGET[0])
+
+
+def test_explicit_override_rejects_same_object_twice():
+    p = Precision(num_classes=5, average="macro")
+    mc = MetricCollection({"a": p, "b": p}, compute_groups=[["a", "b"]])
+    with pytest.raises(MetricsTPUUserError, match="several collection keys"):
+        mc.update(PREDS[0], TARGET[0])
+
+
+def test_failed_group_dispatch_breaks_group_without_clobbering_siblings():
+    """A forward/update that raises mid-dispatch disbands the group: the
+    member that was mid-mutation keeps its partial state (ungrouped
+    semantics), untouched siblings keep their accumulation, and the next
+    dispatch cannot re-link anyone onto the corrupted state."""
+    grouped = MetricCollection(
+        {"p": Precision(num_classes=5, average="macro"), "r": Recall(num_classes=5, average="macro")}
+    )
+    ungrouped = MetricCollection(
+        {"p": Precision(num_classes=5, average="macro"), "r": Recall(num_classes=5, average="macro")},
+        compute_groups=False,
+    )
+    for mc in (grouped, ungrouped):
+        mc(PREDS[0], TARGET[0])
+        with pytest.raises(Exception):
+            # mismatched preds/target lengths: raises inside the dispatched
+            # update, after the batch-default restore wiped the source
+            mc(PREDS[0], TARGET[0][:-5])
+    assert grouped["p"]._compute_group is None  # group disbanded
+    # the untouched sibling keeps its accumulation, exactly like ungrouped
+    np.testing.assert_array_equal(
+        np.asarray(grouped["r"]._state["tp"]), np.asarray(ungrouped["r"]._state["tp"])
+    )
+    grouped.update(PREDS[1], TARGET[1])
+    ungrouped.update(PREDS[1], TARGET[1])
+    np.testing.assert_array_equal(
+        np.asarray(grouped["r"]._state["tp"]), np.asarray(ungrouped["r"]._state["tp"])
+    )
+    # after reset, the partition re-plans and the group re-forms
+    grouped.reset()
+    grouped.update(PREDS[0], TARGET[0])
+    assert grouped.compute_group_keys == [["p", "r"]]
+
+
+def test_direct_reset_detaches():
+    mc = _stat_collection()
+    mc.update(PREDS[0], TARGET[0])
+    mc["rec"].reset()
+    assert mc["rec"]._compute_group is None
+    assert int(np.asarray(mc["rec"]._state["tp"]).sum()) == 0
+    assert int(np.asarray(mc["prec"]._state["tp"]).sum()) > 0
+
+
+def test_collection_reset_regroups_detached_members():
+    mc = _stat_collection()
+    mc.update(PREDS[0], TARGET[0])
+    mc["prec"].update(PREDS[1], TARGET[1])  # detach
+    mc.reset()
+    mc.update(PREDS[0], TARGET[0])
+    assert mc.compute_group_keys == [["f1", "prec", "rec", "spec"]]
+
+
+# ---------------------------------------------------------------------------
+# clone / state_dict round trips (escape-hatch compatibility)
+# ---------------------------------------------------------------------------
+
+
+def test_clone_with_prefix_keeps_groups_and_detaches_from_original():
+    mc = _stat_collection()
+    mc.update(PREDS[0], TARGET[0])
+    val = mc.clone(prefix="val_")
+    assert val.compute_group_keys == [["f1", "prec", "rec", "spec"]]
+    assert val["prec"]._state["tp"] is val["rec"]._state["tp"]
+    assert val["prec"]._state["tp"] is not mc["prec"]._state["tp"]
+    assert sorted(val.compute()) == ["val_f1", "val_prec", "val_rec", "val_spec"]
+    val.update(PREDS[1], TARGET[1])  # the clone accumulates independently
+    assert int(np.asarray(mc["prec"]._update_count)) == 1
+    assert int(np.asarray(val["prec"]._update_count)) == 2
+
+
+def test_state_dict_round_trip_grouped_to_ungrouped_and_back():
+    grouped = _stat_collection()
+    for m in grouped.values():
+        m.persistent(True)
+    for p, t in zip(PREDS, TARGET):
+        grouped.update(p, t)
+    sd = grouped.state_dict()
+    # grouped members each serialize the shared state under their own prefix
+    assert {f"{k}.{s}" for k in grouped.keys() for s in ("tp", "fp", "tn", "fn")} <= set(sd)
+
+    ungrouped = _stat_collection(compute_groups=False)
+    for m in ungrouped.values():
+        m.persistent(True)
+    ungrouped.load_state_dict(sd)
+    _assert_tree_equal(grouped.compute(), ungrouped.compute())
+
+    back = _stat_collection()
+    for m in back.values():
+        m.persistent(True)
+    back.load_state_dict(ungrouped.state_dict())
+    _assert_tree_equal(grouped.compute(), back.compute())
+    # equal loaded states re-group at the next dispatch and stay equivalent
+    back.update(PREDS[0], TARGET[0])
+    assert back.compute_group_keys == [["f1", "prec", "rec", "spec"]]
+    ungrouped.update(PREDS[0], TARGET[0])
+    _assert_tree_equal(back.compute(), ungrouped.compute())
+
+
+def test_load_state_dict_with_divergent_states_does_not_group():
+    donor_a = Precision(num_classes=5, average="macro")
+    donor_b = Recall(num_classes=5, average="macro")
+    donor_a.persistent(True)
+    donor_b.persistent(True)
+    donor_a.update(PREDS[0], TARGET[0])
+    donor_b.update(PREDS[1], TARGET[1])
+    donor_b.update(PREDS[2], TARGET[2])
+    sd = {}
+    sd.update(donor_a.state_dict(prefix="prec."))
+    sd.update(donor_b.state_dict(prefix="rec."))
+    mc = MetricCollection(
+        {"prec": Precision(num_classes=5, average="macro"), "rec": Recall(num_classes=5, average="macro")}
+    )
+    for m in mc.values():
+        m.persistent(True)
+    mc.load_state_dict(sd)
+    mc.update(PREDS[0], TARGET[0])  # triggers re-planning
+    assert mc.compute_group_keys == []  # divergent loads must not share
+    np.testing.assert_array_equal(
+        np.asarray(mc["prec"]._state["tp"]),
+        np.asarray(donor_a._state["tp"]) + np.asarray(
+            Precision(num_classes=5, average="macro")._state["tp"]
+        ) + np.asarray(stat_scores_mod._stat_scores_update(
+            PREDS[0], TARGET[0], reduce="macro", mdmc_reduce=None, threshold=0.5,
+            num_classes=5, top_k=None, multiclass=None, ignore_index=None,
+        )[0]),
+    )

@@ -49,8 +49,20 @@ class LockstepWorld:
         if self._barrier.wait() == 0:
             self.calls += 1
         out = jnp.asarray(np.stack(self._slots))
-        # second rendezvous: every rank reads before the next round overwrites
-        self._barrier.wait()
+        # second rendezvous: every rank reads before the next round overwrites.
+        # A break HERE is tolerated: the gather itself completed (every rank
+        # contributed and this rank already stacked its copy), so a peer that
+        # raised right after reading — e.g. a symmetric typed SyncError from
+        # verifying the gathered header — may abort() before this rank drains
+        # the guard barrier. Its only job (ordering vs a next round) is moot
+        # once a peer aborted: an aborted peer never starts another round, and
+        # a still-healthy peer can't pass this same barrier early. The FIRST
+        # wait above still propagates the break — a rank dying before
+        # contributing is a genuine protocol divergence.
+        try:
+            self._barrier.wait()
+        except threading.BrokenBarrierError:
+            pass
         return out
 
     def run(self, fn: Callable[[int], Any], timeout: float = 120.0) -> List[Any]:

@@ -1,0 +1,344 @@
+"""Distributed sync over compute-grouped collections (ISSUE 3).
+
+Two layers, mirroring the bucketed-sync suite's standards:
+
+- **Lockstep equivalence** (``tests/helpers/fake_world.py``): both ranks run
+  the REAL collection sync concurrently with rendezvous collectives; a
+  grouped collection must produce bit-identical synced/unsynced states to an
+  ungrouped one while moving strictly fewer payload bytes (one gathered
+  state per group instead of one per member).
+- **Fault injection**: a divergent rank inside a grouped collection raises
+  the same typed ``SyncError`` on every rank (symmetric failure), and
+  ``on_error="local"`` degradation falls back per member without breaking
+  the group's shared-state views.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.parallel.sync as sync_mod
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.parallel.bucketing import clear_sync_plan_cache
+from metrics_tpu import AveragePrecision, Precision, PrecisionRecallCurve, Recall, ROC
+from metrics_tpu import F1, Specificity
+from metrics_tpu.utils.exceptions import (
+    NonFiniteStateError,
+    StateDivergenceError,
+    SyncError,
+)
+from tests.helpers.fake_world import LockstepWorld
+
+WORLD = 2
+
+rng = np.random.RandomState(11)
+PREDS = [jnp.asarray(rng.rand(32, 5).astype(np.float32)) for _ in range(WORLD)]
+TARGET = [jnp.asarray(rng.randint(0, 5, (32,))) for _ in range(WORLD)]
+BPREDS = [jnp.asarray(rng.rand(16 + 8 * r).astype(np.float32)) for r in range(WORLD)]
+BTARGET = [jnp.asarray(rng.randint(0, 2, (16 + 8 * r,)).astype(np.int32)) for r in range(WORLD)]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_cache():
+    clear_sync_plan_cache()
+    yield
+    clear_sync_plan_cache()
+
+
+class _CountingAllgather:
+    """Wrap a LockstepWorld's allgather, accounting payload bytes.
+
+    The increment is locked: both rank THREADS call this concurrently, and
+    an unlocked ``self.bytes += n`` is a read-modify-write that can lose an
+    update under load (observed as a flaky 40-byte deficit in full-suite
+    runs)."""
+
+    def __init__(self, world: LockstepWorld):
+        self.world = world
+        self.bytes = 0
+        self._lock = threading.Lock()
+
+    def __call__(self, x):
+        n = np.asarray(x).nbytes * self.world.world
+        with self._lock:
+            self.bytes += n
+        return self.world.allgather(x)
+
+
+@pytest.fixture
+def lockstep(monkeypatch):
+    world = LockstepWorld(WORLD)
+    counter = _CountingAllgather(world)
+    monkeypatch.setattr(jax, "process_count", lambda: world.world)
+    monkeypatch.setattr(sync_mod, "_raw_process_allgather", counter)
+    return world, counter
+
+
+def _stat_collection(**kwargs):
+    return MetricCollection(
+        {
+            "prec": Precision(num_classes=5, average="macro"),
+            "rec": Recall(num_classes=5, average="macro"),
+            "f1": F1(num_classes=5, average="macro"),
+            "spec": Specificity(num_classes=5, average="macro"),
+        },
+        **kwargs,
+    )
+
+
+def _curve_collection(**kwargs):
+    return MetricCollection(
+        {
+            "roc": ROC(pos_label=1).with_capacity(64),
+            "prc": PrecisionRecallCurve(pos_label=1).with_capacity(64),
+            "ap": AveragePrecision(pos_label=1).with_capacity(64),
+        },
+        **kwargs,
+    )
+
+
+def _state_snapshot(mc):
+    out = {}
+    for key, m in mc.items():
+        for name, v in m._state.items():
+            out[f"{key}.{name}"] = v
+    return jax.tree_util.tree_map(np.asarray, out)
+
+
+def _assert_snapshots_equal(a, b):
+    assert sorted(a) == sorted(b)
+    for k in a:
+        la, lb = jax.tree_util.tree_leaves(a[k]), jax.tree_util.tree_leaves(b[k])
+        assert len(la) == len(lb), k
+        for x, y in zip(la, lb):
+            assert np.asarray(x).dtype == np.asarray(y).dtype, k
+            assert np.asarray(x).tobytes() == np.asarray(y).tobytes(), k
+
+
+def _run_collection_sync(monkeypatch, build, feed, grouped, fused=True):
+    """Both ranks build + feed a collection, sync, snapshot synced state +
+    compute, unsync, snapshot restored state. Returns per-rank results and
+    the byte counter."""
+    if not fused:
+        monkeypatch.setenv("METRICS_TPU_FUSED_SYNC", "0")
+    world = LockstepWorld(WORLD)
+    counter = _CountingAllgather(world)
+    monkeypatch.setattr(jax, "process_count", lambda: world.world)
+    monkeypatch.setattr(sync_mod, "_raw_process_allgather", counter)
+    clear_sync_plan_cache()
+
+    def body(rank):
+        mc = build(compute_groups=grouped)
+        feed(mc, rank)
+        mc.sync(timeout=0)
+        synced = _state_snapshot(mc)
+        values = jax.tree_util.tree_map(np.asarray, mc.compute())
+        mc.unsync()
+        restored = _state_snapshot(mc)
+        return synced, values, restored
+
+    return world.run(body), counter
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_grouped_stat_sync_bit_identical_and_smaller(monkeypatch, fused):
+    def feed(mc, rank):
+        for m in mc.values():
+            m.sync_timeout = 0
+        mc.update(PREDS[rank], TARGET[rank])
+
+    grouped_out, grouped_counter = _run_collection_sync(
+        monkeypatch, _stat_collection, feed, grouped=True, fused=fused
+    )
+    ungrouped_out, ungrouped_counter = _run_collection_sync(
+        monkeypatch, _stat_collection, feed, grouped=False, fused=fused
+    )
+    for rank in range(WORLD):
+        for part in range(3):
+            _assert_snapshots_equal(grouped_out[rank][part], ungrouped_out[rank][part])
+    if fused:
+        # one gathered tp/fp/tn/fn quartet instead of four: strictly fewer
+        # bytes (deduped behind the combined header, which verifies the
+        # partition-dependent key set across ranks first)
+        assert grouped_counter.bytes < ungrouped_counter.bytes
+    else:
+        # the per-member loop deliberately does NOT dedupe: its collective
+        # schedule must not depend on the (state-dependent) group partition,
+        # or ranks with diverged partitions would desynchronize the channel
+        assert grouped_counter.bytes == ungrouped_counter.bytes
+
+
+def test_grouped_curve_sync_bit_identical_and_smaller(monkeypatch):
+    def feed(mc, rank):
+        for m in mc.values():
+            m.sync_timeout = 0
+        mc.update(BPREDS[rank], BTARGET[rank])
+
+    grouped_out, grouped_counter = _run_collection_sync(
+        monkeypatch, _curve_collection, feed, grouped=True
+    )
+    ungrouped_out, ungrouped_counter = _run_collection_sync(
+        monkeypatch, _curve_collection, feed, grouped=False
+    )
+    for rank in range(WORLD):
+        _assert_snapshots_equal(grouped_out[rank][0], ungrouped_out[rank][0])
+        _assert_snapshots_equal(grouped_out[rank][2], ungrouped_out[rank][2])
+    assert grouped_counter.bytes < ungrouped_counter.bytes
+
+
+def test_grouped_sync_keeps_views_shared_after_unsync(lockstep, monkeypatch):
+    world, _counter = lockstep
+
+    def body(rank):
+        mc = _stat_collection()
+        mc.update(PREDS[rank], TARGET[rank])
+        with mc.sync_context(timeout=0):
+            # synced: every member reads the group's one gathered state
+            assert mc["prec"]._state["tp"] is mc["rec"]._state["tp"]
+            synced_tp = np.asarray(mc["prec"]._state["tp"])
+        # unsynced: views re-linked onto the restored local state
+        assert mc["prec"]._state["tp"] is mc["rec"]._state["tp"]
+        return synced_tp, np.asarray(mc["prec"]._state["tp"])
+
+    results = world.run(body)
+    # both ranks saw the same world-summed counters; locals differ per rank
+    np.testing.assert_array_equal(results[0][0], results[1][0])
+    np.testing.assert_array_equal(
+        results[0][0], results[0][1] + results[1][1]
+    )
+
+
+# ---------------------------------------------------------------------------
+# fault injection on grouped collections
+# ---------------------------------------------------------------------------
+
+
+def test_divergent_rank_raises_same_typed_error_on_all_ranks(monkeypatch):
+    """Rank 1 constructs the group with a different num_classes: the schema
+    hash diverges and BOTH ranks raise the same StateDivergenceError."""
+    world = LockstepWorld(WORLD)
+    monkeypatch.setattr(jax, "process_count", lambda: world.world)
+    monkeypatch.setattr(sync_mod, "_raw_process_allgather", world.allgather)
+    errors = {}
+
+    def body(rank):
+        n = 5 if rank == 0 else 7
+        mc = MetricCollection(
+            {
+                "prec": Precision(num_classes=n, average="macro"),
+                "rec": Recall(num_classes=n, average="macro"),
+            }
+        )
+        mc.update(jnp.asarray(rng.rand(8, n).astype(np.float32)), jnp.asarray(rng.randint(0, n, (8,))))
+        try:
+            mc.sync(timeout=0)
+        except SyncError as err:
+            errors[rank] = type(err)
+            raise
+
+    with pytest.raises(StateDivergenceError):
+        world.run(body)
+    assert errors == {0: StateDivergenceError, 1: StateDivergenceError}
+
+
+def test_poisoned_rank_raises_nonfinite_on_all_ranks(monkeypatch):
+    world = LockstepWorld(WORLD)
+    monkeypatch.setattr(jax, "process_count", lambda: world.world)
+    monkeypatch.setattr(sync_mod, "_raw_process_allgather", world.allgather)
+    errors = {}
+
+    def body(rank):
+        mc = MetricCollection(
+            {
+                "roc": ROC(pos_label=1).enable_check_finite(),
+                "prc": PrecisionRecallCurve(pos_label=1).enable_check_finite(),
+            }
+        )
+        preds = np.asarray(BPREDS[0]).copy()
+        if rank == 1:
+            preds[3] = np.nan
+        mc.update(jnp.asarray(preds), BTARGET[0])
+        assert mc.compute_group_keys == [["prc", "roc"]]
+        try:
+            mc.sync(timeout=0)
+        except SyncError as err:
+            errors[rank] = type(err)
+            raise
+
+    with pytest.raises(NonFiniteStateError):
+        world.run(body)
+    assert errors == {0: NonFiniteStateError, 1: NonFiniteStateError}
+
+
+def test_on_error_local_degrades_grouped_collection_without_breaking_views(monkeypatch):
+    """A failed sync under on_error='local'/'warn' leaves every member on
+    local state (each member degrades through its own sync, symmetric
+    across ranks) and keeps the group's shared views (one copy of state)
+    intact."""
+    world = LockstepWorld(WORLD)
+    monkeypatch.setattr(jax, "process_count", lambda: world.world)
+    monkeypatch.setattr(sync_mod, "_raw_process_allgather", world.allgather)
+
+    def body(rank):
+        n = 5 if rank == 0 else 7  # schema divergence on rank 1
+        mc = MetricCollection(
+            {
+                "prec": Precision(num_classes=n, average="macro"),
+                "rec": Recall(num_classes=n, average="macro"),
+            }
+        )
+        p = jnp.asarray(rng.rand(8, n).astype(np.float32))
+        t = jnp.asarray(rng.randint(0, n, (8,)))
+        mc.update(p, t)
+        local_tp = np.asarray(mc["prec"]._state["tp"]).copy()
+        # NOTE: no pytest.warns here — warning filters are process-global and
+        # two rank threads clobber each other's catch_warnings contexts; the
+        # warning text itself is covered by the fault-injection suite
+        mc.sync(timeout=0, on_error="warn")
+        # degraded: nothing synced, every member still on local state
+        assert all(not m._is_synced for m in mc.values())
+        assert all(m._sync_degraded for m in mc.values())
+        np.testing.assert_array_equal(np.asarray(mc["prec"]._state["tp"]), local_tp)
+        # group views survive degradation: still one copy of state
+        assert mc["prec"]._state["tp"] is mc["rec"]._state["tp"]
+        # the checkpoint pattern's paired unsync stays a tolerated no-op
+        mc.unsync()
+        # and the collection keeps accumulating as one group afterwards
+        mc.update(p, t)
+        assert mc["prec"]._state["tp"] is mc["rec"]._state["tp"]
+        np.testing.assert_array_equal(np.asarray(mc["prec"]._state["tp"]), 2 * local_tp)
+        assert mc["prec"]._update_count == 2
+        return True
+
+    assert world.run(body) == [True, True]
+
+
+def test_fused_sync_payload_dedupes_to_unique_states(monkeypatch):
+    """The combined fused plan carries one key per unique group state, so
+    the header's count columns and the collective payload shrink with the
+    group, not the member count."""
+    world = LockstepWorld(WORLD)
+    counter = _CountingAllgather(world)
+    monkeypatch.setattr(jax, "process_count", lambda: world.world)
+    monkeypatch.setattr(sync_mod, "_raw_process_allgather", counter)
+
+    captured = {}
+    orig = sync_mod.host_sync_state
+
+    def spying(state, reductions, **kwargs):
+        captured.setdefault("n_keys", len(state))
+        return orig(state, reductions, **kwargs)
+
+    monkeypatch.setattr(sync_mod, "host_sync_state", spying)
+
+    def body(rank):
+        mc = _stat_collection()
+        mc.update(PREDS[rank], TARGET[rank])
+        mc.sync(timeout=0)
+        mc.unsync()
+
+    world.run(body)
+    # 4 members x 4 states each, deduped to the group's single quartet
+    assert captured["n_keys"] == 4
