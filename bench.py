@@ -3,11 +3,12 @@
 Default (driver contract): runs BASELINE config 1 and prints ONE JSON line
 ``{"metric", "value", "unit", "vs_baseline"}``.
 
-``python bench.py --all`` additionally runs configs 2-9 (one JSON line
+``python bench.py --all`` additionally runs configs 2-10 (one JSON line
 each; ``--config N`` runs a single one; see BASELINE.md for the config
 table and BENCH.md for recorded numbers; config 8 is the host-sync
 collective-fusion accounting added with the bucketed planner, config 9 the
-compute-group update/state dedup accounting).
+compute-group update/state dedup accounting, config 10 the preemption-safe
+checkpoint snapshot/restore latency + restore-after-kill equivalence).
 
 Timing methodology (see BENCH.md): hot paths are timed **on-chip** by
 scanning K steps inside ONE jitted program (``lax.scan``) and dividing — a
@@ -22,8 +23,10 @@ BASELINE.md), timed in-process.
 """
 import json
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -1457,6 +1460,121 @@ def bench_config9() -> None:
     )
 
 
+def bench_config10() -> None:
+    """Config 10: preemption-safe checkpoint — snapshot/restore latency +
+    restore-after-kill correctness.
+
+    The ISSUE-4 acceptance measurement: a 4-metric curve collection
+    (ROC / PrecisionRecallCurve / AveragePrecision / AUROC, one compute
+    group for the first three) with large CatBuffers (2^17 rows each
+    buffer) is driven through half its batches, snapshotted with
+    `save_checkpoint` (timed over REPS saves), then a kill is simulated —
+    a leftover temp file plus an incomplete newer step — and a FRESH
+    collection restores with `load_checkpoint` (timed) and finishes the
+    remaining batches. Asserts (CI gates contract):
+
+    - the loader ignores the kill debris and resumes the newest COMPLETE
+      snapshot;
+    - every computed value of the resumed run equals the uninterrupted
+      run bit for bit (np.array_equal over the full curve outputs).
+
+    Emits `checkpoint_restore_ms` with `vs_baseline` = save/restore ratio;
+    snapshot latency, shard size and per-state byte counts ride the
+    diagnostic line.
+    """
+    import jax.numpy as jnp
+
+    from metrics_tpu import (
+        AUROC,
+        AveragePrecision,
+        MetricCollection,
+        PrecisionRecallCurve,
+        ROC,
+        load_checkpoint,
+        save_checkpoint,
+    )
+
+    CAPACITY = 1 << 17
+    N_BATCH, BATCH_ROWS = 16, 4096  # 65536 rows accumulated per metric
+    SPLIT = N_BATCH // 2
+
+    rng = np.random.RandomState(10)
+    preds = rng.rand(N_BATCH, BATCH_ROWS).astype(np.float32)
+    target = rng.randint(0, 2, (N_BATCH, BATCH_ROWS))
+
+    def make() -> "MetricCollection":
+        return MetricCollection(
+            {
+                "roc": ROC().with_capacity(CAPACITY),
+                "prc": PrecisionRecallCurve().with_capacity(CAPACITY),
+                "ap": AveragePrecision().with_capacity(CAPACITY),
+                "auroc": AUROC().with_capacity(CAPACITY),
+            }
+        )
+
+    def feed(mc, lo, hi):
+        for i in range(lo, hi):
+            mc.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        return mc
+
+    def flatten(vals):
+        out = {}
+        for k, v in vals.items():
+            leaves = v if isinstance(v, (tuple, list)) else [v]
+            out[k] = [np.asarray(x) for x in leaves]
+        return out
+
+    ckpt_dir = tempfile.mkdtemp(prefix="metrics_tpu_bench10_")
+    try:
+        mc = feed(make(), 0, SPLIT)
+        n_groups = len(mc.compute_group_keys)
+        # snapshot latency (REPS saves into successive steps)
+        t0 = time.perf_counter()
+        for rep in range(REPS):
+            path = save_checkpoint(mc, ckpt_dir, step=rep, rank=0, world=1)
+        snapshot_ms = (time.perf_counter() - t0) / REPS * 1e3
+        shard_bytes = os.path.getsize(path)
+
+        # simulated kill -9 AFTER the last good snapshot: a half-written
+        # temp file plus an incomplete newer step directory
+        debris_dir = os.path.join(ckpt_dir, f"step_{REPS:010d}")
+        os.makedirs(debris_dir)
+        with open(os.path.join(debris_dir, ".tmp-killed.mtck"), "wb") as f:
+            f.write(b"\x00" * 4096)
+
+        fresh = make()
+        t0 = time.perf_counter()
+        load_checkpoint(fresh, ckpt_dir, rank=0, world=1)
+        restore_ms = (time.perf_counter() - t0) * 1e3
+
+        resumed_vals = flatten(feed(fresh, SPLIT, N_BATCH).compute())
+        uninterrupted_vals = flatten(feed(make(), 0, N_BATCH).compute())
+        for k, leaves in uninterrupted_vals.items():
+            assert len(resumed_vals[k]) == len(leaves), k
+            for got, want in zip(resumed_vals[k], leaves):
+                assert np.array_equal(got, want), f"restore-after-kill diverged on {k}"
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    _diag(
+        config=10,
+        members=4,
+        compute_groups=n_groups,
+        capacity=CAPACITY,
+        rows_at_snapshot=SPLIT * BATCH_ROWS,
+        shard_bytes=shard_bytes,
+        snapshot_ms=round(snapshot_ms, 2),
+        restore_ms=round(restore_ms, 2),
+        restore_equals_uninterrupted=True,
+    )
+    _emit(
+        "checkpoint_restore_ms",
+        round(restore_ms, 2),
+        "ms",
+        round(snapshot_ms / restore_ms, 3) if restore_ms else None,
+    )
+
+
 def main() -> None:
     try:
         platform = _ensure_backend()
@@ -1482,7 +1600,7 @@ def main() -> None:
     except Exception:
         vs = None
     _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
-    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9}
+    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10}
     if "--config" in sys.argv:
         i = sys.argv.index("--config") + 1
         key = sys.argv[i] if i < len(sys.argv) else None

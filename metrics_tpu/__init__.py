@@ -67,11 +67,19 @@ from metrics_tpu.retrieval import (
     RetrievalRecall,
 )
 from metrics_tpu.audio import PIT, SI_SDR, SI_SNR, SNR
+from metrics_tpu.core.checkpoint import (
+    load_checkpoint,
+    prune_checkpoints,
+    save_checkpoint,
+)
 from metrics_tpu.text import BERTScore, BLEUScore, ROUGEScore, WER
 from metrics_tpu.wrappers import BootStrapper, MetricTracker
 
 __all__ = [
     "CatBuffer",
+    "load_checkpoint",
+    "prune_checkpoints",
+    "save_checkpoint",
     "BERTScore",
     "BLEUScore",
     "ROUGEScore",

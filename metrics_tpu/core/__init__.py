@@ -1,3 +1,10 @@
 from metrics_tpu.core.cat_buffer import CatBuffer
+from metrics_tpu.core.checkpoint import (
+    MetricCheckpointer,
+    load_checkpoint,
+    prune_checkpoints,
+    register_manifest_migration,
+    save_checkpoint,
+)
 from metrics_tpu.core.collections import MetricCollection
 from metrics_tpu.core.metric import CompositionalMetric, Metric

@@ -38,6 +38,13 @@ a pytree leaf, so it survives ``scan`` carries, sync (OR across devices) and
 their compute result through :meth:`CatBuffer.poison` — overflow is loud
 everywhere instead of silently overwriting rows. Size ``capacity`` to your
 eval set.
+
+Checkpointing (``core/checkpoint.py``, ``docs/checkpointing.md``): a
+CatBuffer serializes as ``(capacity, buffer rows, count, overflowed)`` with
+a CRC per leaf, and the sticky ``overflowed`` flag round-trips — a corrupt
+accumulation stays loud across a preemption boundary. Elastic resume folds
+shards through :meth:`CatBuffer.merge`, so scale-down (several saved shards
+landing on one rank) needs ``capacity`` sized for the combined row counts.
 """
 from typing import Any, Optional, Sequence, Tuple
 

@@ -144,6 +144,13 @@ class MetricCollection(dict):
         >>> print({k: round(float(v), 4) for k, v in sorted(vals.items())})
         {'acc': 0.6667, 'prec': 0.6667}
 
+    **Checkpointing.** ``save_checkpoint``/``load_checkpoint``
+    (``core/checkpoint.py``) snapshot the whole collection atomically —
+    grouped members store ONE state per compute group (siblings recorded as
+    aliases, re-linked on restore) — and resume elastically at a different
+    world size; :meth:`checkpointer` snapshots transparently every N
+    ``update``/``forward`` calls (``docs/checkpointing.md``).
+
     Args:
         metrics: one Metric, a list/tuple of Metrics, or a dict name->Metric.
         prefix / postfix: added to every key in the output dict.
@@ -503,6 +510,9 @@ class MetricCollection(dict):
                 if id(g) not in group_values:
                     group_values[id(g)] = self._group_forward(g, m, args, kwargs)
                 out[self._set_name(k)] = group_values[id(g)][id(m)]
+        ckpt = getattr(self, "_auto_checkpointer", None)
+        if ckpt is not None:
+            ckpt.after_update(self)
         return out
 
     def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
@@ -520,6 +530,9 @@ class MetricCollection(dict):
             else:
                 handled.update(id(p) for p in g.members)
                 self._group_update(g, m, args, kwargs)
+        ckpt = getattr(self, "_auto_checkpointer", None)
+        if ckpt is not None:
+            ckpt.after_update(self)
 
     def _group_update(
         self, group: _ComputeGroup, source: Metric, args: Tuple, kwargs: Dict[str, Any]
@@ -548,6 +561,14 @@ class MetricCollection(dict):
             p._update_called = True
             p._update_count = source._update_count
         self._relink_group(group, source)
+        # the dispatched update ran on `source`, whose own hook fired inside
+        # _wrap_update; a checkpointer attached to a SIBLING must fire too —
+        # its accumulation advanced just the same (shared state)
+        for p in group.members:
+            if p is not source:
+                ckpt = getattr(p, "_auto_checkpointer", None)
+                if ckpt is not None:
+                    ckpt.after_update(p)
 
     def _group_forward(
         self, group: _ComputeGroup, source: Metric, args: Tuple, kwargs: Dict[str, Any]
@@ -568,6 +589,10 @@ class MetricCollection(dict):
             return {id(p): None for p in group.members}
         accumulated = {k: _copy_state_value(v) for k, v in source._state.items()}
         can_merge = source._can_merge()
+        # the inner updates run on a transient batch state: a member-level
+        # auto-checkpointer must not snapshot it (Metric.forward makes the
+        # same guarantee for the solo path)
+        object.__setattr__(source, "_ckpt_suppress", True)
         try:
             source._restore(source._batch_default_state())
             group.dispatching = True
@@ -610,10 +635,18 @@ class MetricCollection(dict):
             # disband the group so no later re-link clobbers the siblings
             self._break_group(group)
             raise
+        finally:
+            object.__setattr__(source, "_ckpt_suppress", False)
         for p in group.members:
             if p is not source:
                 p._update_count = source._update_count
         self._relink_group(group, source)
+        # fire every member's checkpointer (suppressed during the transient
+        # batch-state phase above): each member's accumulation advanced
+        for p in group.members:
+            ckpt = getattr(p, "_auto_checkpointer", None)
+            if ckpt is not None:
+                ckpt.after_update(p)
         return values
 
     def compute(self) -> Dict[str, Any]:
@@ -656,15 +689,61 @@ class MetricCollection(dict):
             out.update(m.state_dict(prefix=f"{k}."))
         return out
 
-    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = False) -> None:
         """Per-member load. Members leave their compute groups while loading
         (each may be handed divergent state); the partition is re-planned at
         the next dispatch, re-grouping exactly the members whose loaded
-        states are bit-equal."""
+        states are bit-equal.
+
+        With ``strict=True`` the checkpoint must cover every member's every
+        declared state and carry no keys outside them: a typed
+        :class:`~metrics_tpu.utils.exceptions.StateDictMismatchError`
+        listing the missing and unexpected keys is raised *before* any
+        member mutates (unexpected keys are judged collection-wide — a key
+        belonging to one member is never "unexpected" to another)."""
+        if strict:
+            declared = {
+                f"{k}.{name}" for k, m in super().items() for name in m._defaults
+            }
+            missing = sorted(declared - set(state_dict))
+            unexpected = sorted(set(state_dict) - declared)
+            if missing or unexpected:
+                from metrics_tpu.utils.exceptions import StateDictMismatchError
+
+                raise StateDictMismatchError(
+                    "load_state_dict(strict=True) for MetricCollection: "
+                    f"missing keys {missing}, unexpected keys {unexpected}. "
+                    "Nothing was loaded."
+                )
         for k, m in super().items():
             m.load_state_dict(state_dict, prefix=f"{k}.")
         self._groups_planned = False
         self._groups_stale = True
+
+    def checkpointer(
+        self,
+        directory: str,
+        *,
+        every_n_updates: int = 1,
+        keep_last: Optional[int] = None,
+        rank: Optional[int] = None,
+        world: Optional[int] = None,
+    ) -> Any:
+        """Context manager: periodic preemption-safe snapshots from
+        ``update``/``forward`` — the collection-level analogue of
+        :meth:`Metric.checkpointer`. Grouped members snapshot ONE state per
+        compute group (siblings are recorded as aliases and re-link on
+        restore). See ``docs/checkpointing.md``."""
+        from metrics_tpu.core.checkpoint import MetricCheckpointer
+
+        return MetricCheckpointer(
+            self,
+            directory,
+            every_n_updates=every_n_updates,
+            keep_last=keep_last,
+            rank=rank,
+            world=world,
+        )
 
     # ---------------- host sync (fault-tolerance aware) ----------------
 

@@ -44,6 +44,8 @@ from metrics_tpu.utils.data import apply_to_collection, is_traced
 from metrics_tpu.utils.exceptions import (
     MetricsTPUUserError,
     NonFiniteStateError,
+    StateDictMismatchError,
+    StateSchemaError,
     SyncError,
 )
 from metrics_tpu.utils.prints import rank_zero_warn
@@ -150,6 +152,82 @@ def _copy_state_value(v: Any) -> Any:
     return v
 
 
+def _value_spec(x: Any) -> Tuple[str, Tuple[int, ...]]:
+    """(dtype string, shape) of an array-like without materializing it —
+    works for tracers (aval attributes), jnp/np arrays, and python scalars."""
+    if hasattr(x, "dtype") and hasattr(x, "shape"):
+        return str(x.dtype), tuple(x.shape)
+    arr = np.asarray(x)
+    return str(arr.dtype), tuple(arr.shape)
+
+
+def _dtype_category(dtype_str: str) -> str:
+    try:
+        dt = np.dtype(dtype_str)
+    except TypeError:
+        return "floating"  # jax extended floats (bfloat16, float8_*)
+    if dt.kind in "fc":
+        return "floating"
+    if dt.kind in "iu":
+        return "integer"
+    if dt.kind == "b":
+        return "bool"
+    return dt.kind
+
+
+def _merge_leaf_divergences(name: str, a: Any, b: Any, fx: Any, declared: Any) -> List[str]:
+    """Human-readable reasons leaf ``b`` cannot merge into leaf ``a`` under
+    reduction ``fx`` (empty list = mergeable). Mirrors ``merge_states``'s
+    dispatch: cat-family kinds (CatBuffer/list/"cat" arrays) interchange
+    freely and compare item specs; reduce leaves compare full shape and
+    dtype category (float precision moves are legal promotion)."""
+
+    def item_spec(v: Any) -> Optional[Tuple[str, Tuple[int, ...]]]:
+        if isinstance(v, CatBuffer):
+            if v.buffer is None:
+                return None
+            d, s = _value_spec(v.buffer)
+            return d, s[1:]
+        if isinstance(v, (list, tuple)):
+            if not v:
+                return None
+            d, s = _value_spec(v[0])
+            return d, s[1:]
+        d, s = _value_spec(v)
+        return d, s[1:] if s else ()
+
+    cat_family = (
+        isinstance(a, (CatBuffer, list, tuple))
+        or isinstance(b, (CatBuffer, list, tuple))
+        or isinstance(declared, (CatBuffer, list))
+        or fx == "cat"
+    )
+    if cat_family:
+        sa, sb = item_spec(a), item_spec(b)
+        if sa is None or sb is None:
+            return []
+        out = []
+        if sa[1] != sb[1]:
+            out.append(f"{name}: item shape {sb[1]} (incoming) vs {sa[1]} (self)")
+        if _dtype_category(sa[0]) != _dtype_category(sb[0]):
+            # same-category precision moves are legal promotion, but e.g.
+            # float rows into an int buffer would silently truncate via
+            # CatBuffer.append's astype — exactly what this guard is for
+            out.append(f"{name}: item dtype {sb[0]} (incoming) vs {sa[0]} (self)")
+        return out
+    if fx not in _MERGEABLE_FX and not callable(fx):
+        return []  # no algebraic merge anyway; merge_states raises its own error
+    if isinstance(a, (CatBuffer, list, tuple)) != isinstance(b, (CatBuffer, list, tuple)):
+        return [f"{name}: container kind mismatch"]
+    (da, sha), (db, shb) = _value_spec(a), _value_spec(b)
+    out = []
+    if sha != shb:
+        out.append(f"{name}: shape {shb} (incoming) vs {sha} (self)")
+    if _dtype_category(da) != _dtype_category(db):
+        out.append(f"{name}: dtype {db} (incoming) vs {da} (self)")
+    return out
+
+
 class _ComputeGroup:
     """Shared-state link between metrics of a ``MetricCollection`` compute
     group (see ``collections.py``): every member's ``_state`` values alias
@@ -227,6 +305,13 @@ class Metric:
     ``METRICS_TPU_FUSED_SYNC=0`` or per metric via the ``sync_fused``
     attribute (see ``docs/fault_tolerance.md``).
 
+    **Preemption-safe checkpointing.** ``save_checkpoint``/
+    ``load_checkpoint`` (``core/checkpoint.py``) persist the rank-local
+    state atomically (temp → fsync → rename, CRC-verified manifest) and
+    resume it elastically at a different world size via a rank-strided
+    ``merge_states`` fold; :meth:`checkpointer` snapshots transparently
+    every N updates (see ``docs/checkpointing.md``).
+
     Args:
         compute_on_step: return the metric value for the current batch from
             ``forward`` (reference ``metric.py:73``).
@@ -281,6 +366,10 @@ class Metric:
     #: Compute-group link (set by ``MetricCollection`` when this metric is
     #: grouped with schema/update-identical siblings; ``None`` = ungrouped).
     _compute_group: Optional[_ComputeGroup] = None
+
+    #: Active auto-snapshot hook (set by the :meth:`checkpointer` context
+    #: manager; ``None`` = no periodic checkpointing).
+    _auto_checkpointer: Optional[Any] = None
 
     #: Instance attributes a grouped update writes as side effects (e.g. an
     #: inferred ``num_classes`` or input-mode latch). After each group
@@ -576,32 +665,42 @@ class Metric:
 
         accumulated = {k: _copy_state_value(v) for k, v in self._state.items()}
         update_count_supported = self._can_merge()
-        # fresh state -> batch state; CatBuffer states accumulate the batch in
-        # a plain list so the per-batch work is O(batch), not O(capacity) —
-        # merge_states appends the rows into the fixed buffer afterwards
-        self._restore(self._batch_default_state())
-        self.update(*args, **kwargs)
-        batch_state = {k: _copy_state_value(v) for k, v in self._state.items()}
-
-        # batch-local value; the compute wrapper dist-syncs only if
-        # dist_sync_on_step (reference metric.py:194,364 gates on _to_sync)
-        self._to_sync = self.dist_sync_on_step
-        self._computed = None
+        # the auto-checkpointer must not fire off the transient batch state
+        # the inner update writes; suppress it and snapshot the merged
+        # accumulation once, below
+        object.__setattr__(self, "_ckpt_suppress", True)
         try:
-            self._forward_cache = self.compute()
-        finally:
-            self._to_sync = True
-        self._computed = None
-        # the wrapper's sync_context restored the (unsynced) batch state
-        batch_state = {k: _copy_state_value(v) for k, v in self._state.items()}
-
-        if update_count_supported:
-            merged = self.merge_states(accumulated, batch_state)
-            self._restore(merged)
-        else:
-            # non-mergeable state: replay the reference's double-update path
-            self._restore(accumulated)
+            # fresh state -> batch state; CatBuffer states accumulate the batch in
+            # a plain list so the per-batch work is O(batch), not O(capacity) —
+            # merge_states appends the rows into the fixed buffer afterwards
+            self._restore(self._batch_default_state())
             self.update(*args, **kwargs)
+            batch_state = {k: _copy_state_value(v) for k, v in self._state.items()}
+
+            # batch-local value; the compute wrapper dist-syncs only if
+            # dist_sync_on_step (reference metric.py:194,364 gates on _to_sync)
+            self._to_sync = self.dist_sync_on_step
+            self._computed = None
+            try:
+                self._forward_cache = self.compute()
+            finally:
+                self._to_sync = True
+            self._computed = None
+            # the wrapper's sync_context restored the (unsynced) batch state
+            batch_state = {k: _copy_state_value(v) for k, v in self._state.items()}
+
+            if update_count_supported:
+                merged = self.merge_states(accumulated, batch_state)
+                self._restore(merged)
+            else:
+                # non-mergeable state: replay the reference's double-update path
+                self._restore(accumulated)
+                self.update(*args, **kwargs)
+        finally:
+            object.__setattr__(self, "_ckpt_suppress", False)
+        ckpt = self.__dict__.get("_auto_checkpointer")
+        if ckpt is not None:
+            ckpt.after_update(self)
         return self._forward_cache
 
     def update(self, *args: Any, **kwargs: Any) -> None:  # noqa: D102 - abstract
@@ -935,11 +1034,52 @@ class Metric:
                 )
         return out
 
+    def _validate_merge_schema(self, other: Dict[str, Any], what: str) -> None:
+        """Refuse an un-mergeable incoming state *before* touching anything,
+        with the divergent leaves named — instead of the cryptic broadcast/
+        dtype error the raw merge would raise mid-mutation."""
+        missing = [n for n in self._reductions if n not in other]
+        unexpected = [n for n in sorted(other) if n not in self._reductions]
+        divergent: List[str] = []
+        for name, fx in self._reductions.items():
+            if name not in other:
+                continue
+            divergent.extend(
+                _merge_leaf_divergences(
+                    name, self._state[name], other[name], fx, self._defaults[name]
+                )
+            )
+        if missing or unexpected or divergent:
+            raise StateSchemaError(
+                f"merge_state: incoming {what} does not match "
+                f"{type(self).__name__}'s state schema: "
+                + "; ".join(
+                    ([f"missing states: {missing}"] if missing else [])
+                    + ([f"unexpected states: {unexpected}"] if unexpected else [])
+                    + divergent
+                )
+            )
+
     def merge_state(self, incoming: Union["Metric", Dict[str, Any]]) -> None:
-        """Merge another metric's (or raw state dict's) accumulation into self."""
+        """Merge another metric's (or raw state dict's) accumulation into self.
+
+        The incoming schema is validated up front: an incompatible state
+        (mismatched names, shapes, or dtype families — e.g. two metrics
+        constructed with different ``num_classes``) raises a typed
+        :class:`~metrics_tpu.utils.exceptions.StateSchemaError` naming the
+        divergent leaves, before any state mutates. Metrics with equal
+        :meth:`state_fingerprint` skip the per-leaf walk.
+        """
         self._group_detach_if_stray()
-        other = incoming._state if isinstance(incoming, Metric) else incoming
+        if isinstance(incoming, Metric):
+            other = incoming._state
+            if incoming.state_fingerprint() != self.state_fingerprint():
+                self._validate_merge_schema(other, type(incoming).__name__)
+        else:
+            other = incoming
+            self._validate_merge_schema(other, "state dict")
         self._restore(self.merge_states(self._state, other))
+        self._computed = None  # merged state supersedes any memoized result
 
     def _default_state(self) -> Dict[str, Any]:
         """Fresh state with every array leaf a *distinct, newly allocated*
@@ -1014,7 +1154,31 @@ class Metric:
                 out[prefix + name] = np.asarray(v)
         return out
 
-    def load_state_dict(self, state_dict: Dict[str, Any], prefix: str = "") -> None:
+    def load_state_dict(
+        self, state_dict: Dict[str, Any], prefix: str = "", strict: bool = False
+    ) -> None:
+        """Resume accumulated state from a ``state_dict`` snapshot.
+
+        By default (back-compat) declared states absent from the checkpoint
+        are silently skipped — resuming *partial* state. With
+        ``strict=True`` the key sets must match exactly: a typed
+        :class:`~metrics_tpu.utils.exceptions.StateDictMismatchError`
+        listing every missing and unexpected key is raised *before* any
+        state mutates. (Note the default ``state_dict()`` emits only
+        ``persistent`` states; strict loads pair with full snapshots —
+        ``persistent(True)`` or the ``core/checkpoint.py`` subsystem.)
+        """
+        if strict:
+            declared = {prefix + name for name in self._defaults}
+            present = {k for k in state_dict if not prefix or k.startswith(prefix)}
+            missing = sorted(declared - set(state_dict))
+            unexpected = sorted(present - declared)
+            if missing or unexpected:
+                raise StateDictMismatchError(
+                    f"load_state_dict(strict=True) for {type(self).__name__}: "
+                    f"missing keys {missing}, unexpected keys {unexpected}. "
+                    "Nothing was loaded."
+                )
         self._group_detach_if_stray()
         for name in self._defaults:
             key = prefix + name
@@ -1066,6 +1230,41 @@ class Metric:
                     loaded = [loaded.values()] if len(loaded) else []
                 self._state[name] = loaded
                 self._update_called = True
+                # the restored state supersedes any memoized result — without
+                # this, compute() would return the pre-restore cached value
+                self._computed = None
+                self._forward_cache = None
+
+    def checkpointer(
+        self,
+        directory: str,
+        *,
+        every_n_updates: int = 1,
+        keep_last: Optional[int] = None,
+        rank: Optional[int] = None,
+        world: Optional[int] = None,
+    ) -> Any:
+        """Context manager: periodic preemption-safe snapshots from ``update``.
+
+        While the context is active, every ``every_n_updates``-th eager
+        ``update``/``forward`` atomically snapshots this metric's rank-local
+        state into ``directory`` (``core/checkpoint.py``: CRC-verified
+        manifest, write-temp → fsync → rename, ``keep_last`` retention), and
+        a clean exit flushes the tail. Resume with
+        :func:`~metrics_tpu.core.checkpoint.load_checkpoint` — at the same
+        world size or elastically at a different one. See
+        ``docs/checkpointing.md``.
+        """
+        from metrics_tpu.core.checkpoint import MetricCheckpointer
+
+        return MetricCheckpointer(
+            self,
+            directory,
+            every_n_updates=every_n_updates,
+            keep_last=keep_last,
+            rank=rank,
+            world=world,
+        )
 
     def to_device(self, device: Any) -> "Metric":
         """Move all array state to ``device`` (analogue of ``.to()``)."""
@@ -1331,9 +1530,10 @@ def _wrap_update(update: Callable) -> Callable:
         self._update_called = True
         from metrics_tpu.utils.checks import _tracing_active
 
-        if not _tracing_active() and not any(
+        eager = not _tracing_active() and not any(
             is_traced(leaf) for leaf in jax.tree_util.tree_leaves((args, kwargs))
-        ):
+        )
+        if eager:
             # per-update counter: rides the health word so update-count skew
             # across ranks is detectable before a payload gather. Trace-time
             # invocations (pure_update/pure_forward under jit) don't count:
@@ -1382,6 +1582,18 @@ def _wrap_update(update: Callable) -> Callable:
             flag = _update_nonfinite_flag(self._state, (args, kwargs), prev_list_lens)
             prev = jnp.asarray(self._state[NONFINITE_STATE], jnp.int32)
             self._state[NONFINITE_STATE] = jnp.maximum(prev, flag)
+        ckpt = self.__dict__.get("_auto_checkpointer")
+        if (
+            ckpt is not None
+            and eager
+            and not self.__dict__.get("_ckpt_suppress", False)
+            and not self.__dict__.get("_pure_mode", False)
+        ):
+            # periodic durability (Metric.checkpointer): the accumulated
+            # state is complete and concrete here. forward() suppresses this
+            # (its inner updates run on a transient batch state) and fires
+            # the hook itself once the merged state is in place.
+            ckpt.after_update(self)
         return out
 
     wrapped_func._wrapped = True  # type: ignore[attr-defined]

@@ -96,6 +96,7 @@ __all__ = [
     "cat_family_names",
     "cat_row_count",
     "header_cat_lengths",
+    "fingerprint_crc",
     "state_has_nonfinite",
     "state_poisoned",
     "state_schema_hash",
@@ -221,6 +222,31 @@ def state_schema_hash(state: Dict[str, Any], reductions: Dict[str, Any]) -> int:
     import zlib
 
     return zlib.crc32(state_schema_parts(state, reductions).encode()) & 0x7FFFFFFF
+
+
+def fingerprint_crc(fingerprint: Any) -> int:
+    """Stable 31-bit CRC over a ``Metric.state_fingerprint()`` tuple.
+
+    The raw fingerprint compares callable reductions by ``id(fx)`` — exactly
+    right for in-process compute-group planning, useless across process
+    boundaries (a restarted job re-imports every function at a new address).
+    This digest masks callable identities down to the literal ``"callable"``
+    tag before hashing, making it the *durable* form of the fingerprint:
+    equal across save/restore of the same metric class + configuration,
+    different whenever names, kinds, shapes, dtypes, reset defaults, or
+    string reductions differ. The checkpoint manifest
+    (``core/checkpoint.py``) stores it next to the health-word schema CRC.
+    """
+    import zlib
+
+    def _mask(part: Any) -> Any:
+        if isinstance(part, tuple):
+            if len(part) == 2 and part[0] == "callable" and isinstance(part[1], int):
+                return "callable"
+            return tuple(_mask(p) for p in part)
+        return part
+
+    return zlib.crc32(repr(_mask(fingerprint)).encode()) & 0x7FFFFFFF
 
 
 def _is_cat_family(kind: str, fx: Any) -> bool:

@@ -1,5 +1,13 @@
 """Durable metric checkpointing via orbax — the TPU-ecosystem standard.
 
+.. note::
+    For preemption-safe durability — atomic writes, CRC integrity
+    verification, manifest versioning, and elastic ``W -> W'`` rank
+    resume — use the native subsystem in ``metrics_tpu/core/checkpoint.py``
+    (``save_checkpoint``/``load_checkpoint``, ``docs/checkpointing.md``).
+    This module remains the orbax interop path: the ecosystem-standard
+    container format, with none of those guarantees.
+
 The reference persists metric state through ``nn.Module.state_dict`` inside
 the host framework's checkpoint (reference ``metric.py:526-569``); the
 documented pattern for *globally consistent* checkpoints wraps ``state_dict``

@@ -50,5 +50,45 @@ class NonFiniteStateError(SyncError):
     """
 
 
+class StateSchemaError(MetricsTPUUserError):
+    """Two metric states that must share a schema do not.
+
+    Raised by ``Metric.merge_state`` (and the checkpoint loader) *before*
+    any state is touched when the incoming state's leaves diverge from the
+    target's — mismatched names, kinds, shapes or dtype families. The
+    message names every divergent leaf, replacing the cryptic broadcast/
+    dtype errors the raw merge would produce mid-mutation.
+    """
+
+
+class StateDictMismatchError(MetricsTPUUserError):
+    """``load_state_dict(strict=True)`` found missing or unexpected keys.
+
+    The default (non-strict) load silently skips states absent from the
+    checkpoint — resuming *partial* state. Strict mode raises this instead,
+    listing both the declared states the checkpoint lacks and the
+    checkpoint keys no declared state claims, before any state is mutated.
+    """
+
+
+class CheckpointError(RuntimeError):
+    """Base class for durable metric-checkpoint failures.
+
+    Covers everything that can go wrong between a snapshot directory and a
+    resumed metric: no usable snapshot, unsupported manifest versions, and
+    (via :class:`CheckpointCorruptError`) byte-level corruption.
+    """
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A checkpoint file failed integrity verification.
+
+    Raised when any byte-level check fails — bad magic, header or per-leaf
+    CRC mismatch, truncation, impossible offsets. The loader verifies the
+    whole file *before* mutating any metric state, so a corrupt checkpoint
+    can never partially resume: the typed error is the only outcome.
+    """
+
+
 # Alias kept for users migrating from the reference library.
 TorchMetricsUserError = MetricsTPUUserError

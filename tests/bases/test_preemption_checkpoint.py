@@ -1,0 +1,577 @@
+"""Preemption-safe checkpointing: atomic snapshots, integrity verification,
+crash injection, auto-snapshot hook, strict loads, merge-schema validation.
+
+The acceptance bar (ISSUE 4): a kill/truncate/bit-flip at ANY byte offset of
+a snapshot never yields a loadable-but-wrong checkpoint — the loader either
+returns state identical to what was saved or raises the typed
+``CheckpointCorruptError``. Elastic resume is covered by
+``test_elastic_resume.py``.
+"""
+import json
+import os
+import struct
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    AUROC,
+    Accuracy,
+    MetricCollection,
+    Precision,
+    Recall,
+    load_checkpoint,
+    save_checkpoint,
+)
+from metrics_tpu.core.checkpoint import (
+    _MIGRATIONS,
+    MANIFEST_VERSION,
+    available_steps,
+    latest_step,
+    prune_checkpoints,
+    register_manifest_migration,
+)
+from metrics_tpu.utils.exceptions import (
+    CheckpointCorruptError,
+    CheckpointError,
+    MetricsTPUUserError,
+    StateDictMismatchError,
+    StateSchemaError,
+)
+
+rng = np.random.RandomState(4)
+PREDS = rng.rand(10, 16, 10).astype(np.float32)
+TARGET = rng.randint(0, 10, (10, 16))
+BPREDS = rng.rand(10, 32).astype(np.float32)
+BTARGET = rng.randint(0, 2, (10, 32))
+
+
+def _acc(n: int = 10) -> Accuracy:
+    return Accuracy(num_classes=n)
+
+
+def _feed(metric, idxs, preds=PREDS, target=TARGET):
+    for i in idxs:
+        metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    return metric
+
+
+# ---------------------------------------------------------------------------
+# round trips
+# ---------------------------------------------------------------------------
+
+
+def test_metric_roundtrip_resume(tmp_path):
+    m = _feed(_acc(), range(5))
+    save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    m2 = load_checkpoint(_acc(), str(tmp_path), rank=0, world=1)
+    assert m2._update_count == 5
+    _feed(m2, range(5, 10))
+    expected = (np.argmax(PREDS, -1) == TARGET).mean()
+    np.testing.assert_allclose(float(m2.compute()), expected, atol=1e-6)
+
+
+def test_collection_roundtrip_resume(tmp_path):
+    mc = MetricCollection({"acc": _acc(), "prec": Precision(num_classes=10, average="macro")})
+    _feed(mc, range(4))
+    vals = {k: np.asarray(v) for k, v in mc.compute().items()}
+    save_checkpoint(mc, str(tmp_path), rank=0, world=1)
+    mc2 = MetricCollection({"acc": _acc(), "prec": Precision(num_classes=10, average="macro")})
+    load_checkpoint(mc2, str(tmp_path), rank=0, world=1)
+    for k, v in mc2.compute().items():
+        np.testing.assert_array_equal(np.asarray(v), vals[k])
+    assert mc2["acc"]._update_count == 4
+
+
+def test_catbuffer_roundtrip_preserves_overflow_flag(tmp_path):
+    m = AUROC().with_capacity(64)
+    m.update(jnp.asarray(BPREDS[0]), jnp.asarray(BTARGET[0]))
+    m._state["preds"].overflowed = jnp.asarray(True)  # simulate an in-jit overflow
+    save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    m2 = load_checkpoint(AUROC().with_capacity(64), str(tmp_path), rank=0, world=1)
+    assert bool(np.asarray(m2._state["preds"].overflowed))
+    with pytest.raises(MetricsTPUUserError, match="overflowed"):
+        m2._state["preds"].values()  # corruption stays loud after resume
+
+
+def test_roundtrip_preserves_poison_flag(tmp_path):
+    m = _acc().enable_check_finite()
+    bad = PREDS[0].copy()
+    bad[0, 0] = np.nan
+    m.update(jnp.asarray(bad), jnp.asarray(TARGET[0]))
+    assert int(np.asarray(m._state["_nonfinite"])) == 1
+    save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    m2 = load_checkpoint(_acc().enable_check_finite(), str(tmp_path), rank=0, world=1)
+    assert int(np.asarray(m2._state["_nonfinite"])) == 1  # still poisoned, still loud
+
+
+def test_set_dtype_between_save_and_load(tmp_path):
+    def warm(metric):
+        # AUROC infers its input mode from the first update; warm + reset so
+        # the restored metric can compute without a fresh batch
+        metric.update(jnp.asarray(BPREDS[1]), jnp.asarray(BTARGET[1]))
+        metric.reset()
+        return metric
+
+    m = AUROC()
+    m.update(jnp.asarray(BPREDS[0]), jnp.asarray(BTARGET[0]))
+    save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    # the restore re-casts floating leaves to the target's declared dtype
+    m2 = load_checkpoint(warm(AUROC()).set_dtype(jnp.float16), str(tmp_path), rank=0, world=1)
+    assert all(np.asarray(x).dtype == np.float16 for x in m2._state["preds"])
+    m3 = load_checkpoint(warm(AUROC()), str(tmp_path), rank=0, world=1)
+    np.testing.assert_allclose(float(m2.compute()), float(m3.compute()), atol=1e-2)
+
+
+def test_to_device_between_save_and_load(tmp_path):
+    m = _feed(_acc(), range(2))
+    save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    m2 = _acc().to_device(jax.devices("cpu")[0])
+    load_checkpoint(m2, str(tmp_path), rank=0, world=1)
+    np.testing.assert_array_equal(
+        np.asarray(m2._state["correct"]), np.asarray(m._state["correct"])
+    )
+
+
+@pytest.mark.parametrize("save_grouped,load_grouped", [(True, False), (False, True)])
+def test_grouped_ungrouped_collection_resume(tmp_path, save_grouped, load_grouped):
+    def make(grouped):
+        return MetricCollection(
+            {
+                "p": Precision(num_classes=10, average="macro"),
+                "r": Recall(num_classes=10, average="macro"),
+            },
+            compute_groups=grouped,
+        )
+
+    mc = _feed(make(save_grouped), range(3))
+    assert bool(mc.compute_group_keys) == save_grouped
+    vals = {k: np.asarray(v) for k, v in mc.compute().items()}
+    save_checkpoint(mc, str(tmp_path), rank=0, world=1)
+    mc2 = load_checkpoint(make(load_grouped), str(tmp_path), rank=0, world=1)
+    for k, v in mc2.compute().items():
+        np.testing.assert_array_equal(np.asarray(v), vals[k])
+    # a grouped loader re-forms its group from the bit-equal loaded states
+    _feed(mc2, range(3, 5))
+    assert bool(mc2.compute_group_keys) == load_grouped
+
+
+def test_grouped_snapshot_stores_one_state_per_group(tmp_path):
+    mc = MetricCollection(
+        {"p": Precision(num_classes=10, average="macro"), "r": Recall(num_classes=10, average="macro")}
+    )
+    _feed(mc, range(2))
+    assert mc.compute_group_keys  # grouped
+    path = save_checkpoint(mc, str(tmp_path), rank=0, world=1)
+    blob = open(path, "rb").read()
+    hlen, _ = struct.unpack_from("<QI", blob, 8)
+    manifest = json.loads(blob[20 : 20 + hlen])
+    recs = manifest["metrics"]
+    with_states = [k for k, r in recs.items() if "states" in r]
+    aliases = [k for k, r in recs.items() if "alias_of" in r]
+    assert len(with_states) == 1 and len(aliases) == 1
+    assert recs[aliases[0]]["alias_of"] == with_states[0]
+    assert manifest["groups"]
+
+
+# ---------------------------------------------------------------------------
+# atomicity + crash injection
+# ---------------------------------------------------------------------------
+
+
+def test_crash_injection_truncate_and_bitflip_never_silent(tmp_path):
+    """Mutate the snapshot at every sampled byte offset — truncation and a
+    bit flip — and assert the loader NEVER returns wrong state silently."""
+    m = _feed(_acc(), range(3))
+    path = save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    blob = open(path, "rb").read()
+    reference = {k: np.asarray(v) for k, v in m._state.items()}
+    caught = benign = 0
+    offsets = list(range(0, len(blob), 7)) + [len(blob) - 1]
+    for off in offsets:
+        truncated = blob[:off]
+        flipped = blob[:off] + bytes([blob[off] ^ 0x10]) + blob[off + 1 :]
+        for mutant in (truncated, flipped):
+            with open(path, "wb") as f:
+                f.write(mutant)
+            fresh = _acc()
+            try:
+                load_checkpoint(fresh, str(tmp_path), step=0, rank=0, world=1)
+            except (CheckpointCorruptError, CheckpointError):
+                caught += 1
+                continue
+            # a load that "succeeded" must be value-identical to the original
+            for k, v in reference.items():
+                np.testing.assert_array_equal(np.asarray(fresh._state[k]), v)
+            benign += 1
+    assert caught > 0
+    # truncations alone guarantee a majority of corrupt outcomes
+    assert caught >= len(offsets)
+
+
+def test_kill_during_save_leaves_previous_snapshot_loadable(tmp_path):
+    m = _feed(_acc(), range(2))
+    save_checkpoint(m, str(tmp_path), step=0, rank=0, world=1)
+    # simulate a kill -9 mid-save of step 1: only the temp file exists
+    step_dir = os.path.join(str(tmp_path), "step_0000000001")
+    os.makedirs(step_dir)
+    with open(os.path.join(step_dir, ".tmp-dead.mtck"), "wb") as f:
+        f.write(b"half-written garbage")
+    m2 = load_checkpoint(_acc(), str(tmp_path), rank=0, world=1)
+    assert m2._update_count == 2  # the previous complete snapshot
+
+
+def test_incomplete_multirank_step_skipped(tmp_path):
+    m = _feed(_acc(), range(2))
+    for r in range(2):
+        save_checkpoint(m, str(tmp_path), step=0, rank=r, world=2)
+    # step 1: only rank 0's shard survived the preemption
+    save_checkpoint(m, str(tmp_path), step=1, rank=0, world=2)
+    with pytest.warns(RuntimeWarning, match="incomplete checkpoint step 1"):
+        m2 = load_checkpoint(_acc(), str(tmp_path), rank=0, world=2)
+    assert m2._update_count == 2
+    with pytest.raises(CheckpointError, match="incomplete"):
+        load_checkpoint(_acc(), str(tmp_path), step=1, rank=0, world=2)
+
+
+def test_retention_prunes_old_complete_snapshots(tmp_path):
+    m = _feed(_acc(), range(1))
+    for step in range(5):
+        save_checkpoint(m, str(tmp_path), step=step, rank=0, world=1, keep_last=2)
+    assert available_steps(str(tmp_path)) == [3, 4]
+    assert latest_step(str(tmp_path)) == 4
+    with pytest.raises(MetricsTPUUserError):
+        prune_checkpoints(str(tmp_path), keep_last=0)
+
+
+def test_save_refuses_synced_state(tmp_path):
+    m = _feed(_acc(), range(1))
+    m._is_synced = True
+    with pytest.raises(MetricsTPUUserError, match="PRE-sync"):
+        save_checkpoint(m, str(tmp_path), rank=0, world=1)
+
+
+def test_load_missing_directory_raises(tmp_path):
+    with pytest.raises(CheckpointError, match="no complete checkpoint"):
+        load_checkpoint(_acc(), str(tmp_path / "nope"), rank=0, world=1)
+
+
+def test_load_refuses_synced_state(tmp_path):
+    m = _feed(_acc(), range(1))
+    save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    target = _feed(_acc(), range(1))
+    target._is_synced = True
+    with pytest.raises(MetricsTPUUserError, match="unsync"):
+        load_checkpoint(target, str(tmp_path), rank=0, world=1)
+
+
+def test_restore_invalidates_compute_cache(tmp_path):
+    """compute() memoizes; a restore must supersede the cached value."""
+    m = _feed(_acc(), range(4))
+    save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    target = _feed(_acc(), range(1))
+    stale = float(target.compute())  # memoized in _computed
+    load_checkpoint(target, str(tmp_path), rank=0, world=1)
+    expected = (np.argmax(PREDS[:4], -1) == TARGET[:4]).mean()
+    assert float(target.compute()) != stale or stale == pytest.approx(expected)
+    np.testing.assert_allclose(float(target.compute()), expected, atol=1e-6)
+    # merge_state invalidates the cache too
+    a, b = _feed(_acc(), range(1)), _feed(_acc(), [1])
+    float(a.compute())
+    a.merge_state(b)
+    np.testing.assert_allclose(
+        float(a.compute()), (np.argmax(PREDS[:2], -1) == TARGET[:2]).mean(), atol=1e-6
+    )
+
+
+# ---------------------------------------------------------------------------
+# manifest versioning + migrations
+# ---------------------------------------------------------------------------
+
+
+def _rewrite_manifest(path, mutate):
+    blob = open(path, "rb").read()
+    hlen, _ = struct.unpack_from("<QI", blob, 8)
+    manifest = json.loads(blob[20 : 20 + hlen])
+    mutate(manifest)
+    header = json.dumps(manifest, sort_keys=True, separators=(",", ":")).encode()
+    with open(path, "wb") as f:
+        f.write(
+            b"MTPUCKPT"
+            + struct.pack("<QI", len(header), zlib.crc32(header) & 0xFFFFFFFF)
+            + header
+            + blob[20 + hlen :]
+        )
+
+
+@pytest.fixture
+def clean_migrations():
+    saved = dict(_MIGRATIONS)
+    _MIGRATIONS.clear()
+    yield
+    _MIGRATIONS.clear()
+    _MIGRATIONS.update(saved)
+
+
+def test_old_manifest_requires_migration(tmp_path, clean_migrations):
+    m = _feed(_acc(), range(2))
+    path = save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    _rewrite_manifest(path, lambda man: man.update(manifest_version=0))
+    with pytest.raises(CheckpointError, match="no migration"):
+        load_checkpoint(_acc(), str(tmp_path), rank=0, world=1)
+
+    def upgrade_v0(man):
+        man = dict(man)
+        man["manifest_version"] = MANIFEST_VERSION
+        return man
+
+    register_manifest_migration(0, upgrade_v0)
+    m2 = load_checkpoint(_acc(), str(tmp_path), rank=0, world=1)
+    assert m2._update_count == 2
+
+
+def test_newer_manifest_version_refused(tmp_path):
+    m = _feed(_acc(), range(1))
+    path = save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    _rewrite_manifest(path, lambda man: man.update(manifest_version=MANIFEST_VERSION + 1))
+    with pytest.raises(CheckpointError, match="newer"):
+        load_checkpoint(_acc(), str(tmp_path), rank=0, world=1)
+
+
+def test_non_advancing_migration_refused(tmp_path, clean_migrations):
+    m = _feed(_acc(), range(1))
+    path = save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    _rewrite_manifest(path, lambda man: man.update(manifest_version=0))
+    register_manifest_migration(0, lambda man: man)  # does not bump the version
+    with pytest.raises(CheckpointError, match="did not advance"):
+        load_checkpoint(_acc(), str(tmp_path), rank=0, world=1)
+
+
+# ---------------------------------------------------------------------------
+# schema validation on restore
+# ---------------------------------------------------------------------------
+
+
+def test_schema_mismatch_raises_before_mutation(tmp_path):
+    m = _feed(Precision(num_classes=10, average="macro"), range(2))
+    save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    target = Precision(num_classes=5, average="macro")
+    target.update(jnp.asarray(PREDS[0, :, :5]), jnp.asarray(TARGET[0] % 5))
+    before = {k: np.asarray(v) for k, v in target._state.items()}
+    with pytest.raises(StateSchemaError, match="tp"):
+        load_checkpoint(target, str(tmp_path), rank=0, world=1)
+    for k, v in before.items():  # all-or-nothing: nothing mutated
+        np.testing.assert_array_equal(np.asarray(target._state[k]), v)
+
+
+def test_collection_key_mismatch_raises(tmp_path):
+    mc = MetricCollection({"acc": _acc()})
+    _feed(mc, range(1))
+    save_checkpoint(mc, str(tmp_path), rank=0, world=1)
+    with pytest.raises(StateSchemaError, match="missing.*unexpected"):
+        load_checkpoint(MetricCollection({"other": _acc()}), str(tmp_path), rank=0, world=1)
+    with pytest.raises(StateSchemaError, match="the target is a bare"):
+        load_checkpoint(_acc(), str(tmp_path), rank=0, world=1)
+
+
+# ---------------------------------------------------------------------------
+# auto-snapshot hook
+# ---------------------------------------------------------------------------
+
+
+def test_checkpointer_periodic_and_final_flush(tmp_path):
+    m = _acc()
+    with m.checkpointer(str(tmp_path), every_n_updates=3, keep_last=2, rank=0, world=1) as ck:
+        _feed(m, range(8))
+    # snapshots after updates 3 and 6, plus the exit flush at 8
+    assert len(ck.snapshots) == 3
+    assert available_steps(str(tmp_path)) == [1, 2]  # keep_last=2 pruned step 0
+    m2 = load_checkpoint(_acc(), str(tmp_path), rank=0, world=1)
+    assert m2._update_count == 8
+    expected = (np.argmax(PREDS[:8], -1) == TARGET[:8]).mean()
+    np.testing.assert_allclose(float(m2.compute()), expected, atol=1e-6)
+
+
+def test_checkpointer_forward_snapshots_merged_state(tmp_path):
+    m = _acc()
+    with m.checkpointer(str(tmp_path), every_n_updates=1, rank=0, world=1) as ck:
+        m(jnp.asarray(PREDS[0]), jnp.asarray(TARGET[0]))
+        m(jnp.asarray(PREDS[1]), jnp.asarray(TARGET[1]))
+    assert len(ck.snapshots) == 2
+    m2 = load_checkpoint(_acc(), str(tmp_path), rank=0, world=1)
+    expected = (np.argmax(PREDS[:2], -1) == TARGET[:2]).mean()
+    np.testing.assert_allclose(float(m2.compute()), expected, atol=1e-6)
+
+
+def test_checkpointer_on_collection(tmp_path):
+    mc = MetricCollection(
+        {"p": Precision(num_classes=10, average="macro"), "r": Recall(num_classes=10, average="macro")}
+    )
+    with mc.checkpointer(str(tmp_path), every_n_updates=2, rank=0, world=1) as ck:
+        _feed(mc, range(4))
+    assert len(ck.snapshots) == 2
+    mc2 = MetricCollection(
+        {"p": Precision(num_classes=10, average="macro"), "r": Recall(num_classes=10, average="macro")}
+    )
+    load_checkpoint(mc2, str(tmp_path), rank=0, world=1)
+    for k, v in mc2.compute().items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(mc.compute()[k]))
+
+
+def test_checkpointer_multirank_steps_align(tmp_path):
+    """Each rank's checkpointer numbers its n-th snapshot identically even
+    when ranks enter the context at different times (a later rank must JOIN
+    its peers' partial steps, not skip past them), so shards line up into
+    complete, loadable steps."""
+    for r in range(2):  # strictly sequential "ranks" — the worst skew
+        m = _acc()
+        with m.checkpointer(str(tmp_path), every_n_updates=2, rank=r, world=2):
+            _feed(m, range(r, 6, 2))
+    steps = available_steps(str(tmp_path))
+    assert steps == [0, 1]  # snapshot at 2 updates + exit flush at 3, both ranks
+    m2 = load_checkpoint(_acc(), str(tmp_path), rank=0, world=1)  # folds both shards
+    assert m2._update_count == 6
+
+
+def test_checkpointer_nesting_refused(tmp_path):
+    m = _acc()
+    with m.checkpointer(str(tmp_path), rank=0, world=1):
+        with pytest.raises(MetricsTPUUserError, match="already has an active checkpointer"):
+            with m.checkpointer(str(tmp_path), rank=0, world=1):
+                pass
+
+
+def test_checkpointer_invalid_interval(tmp_path):
+    with pytest.raises(MetricsTPUUserError):
+        _acc().checkpointer(str(tmp_path), every_n_updates=0)
+
+
+# ---------------------------------------------------------------------------
+# satellite: strict load_state_dict
+# ---------------------------------------------------------------------------
+
+
+def test_load_state_dict_default_still_skips_silently():
+    m = _feed(_acc(), range(1))
+    before = np.asarray(m._state["correct"])
+    m.load_state_dict({})  # nothing happens — historical behavior
+    np.testing.assert_array_equal(np.asarray(m._state["correct"]), before)
+
+
+def test_load_state_dict_strict_missing_and_unexpected():
+    m = _acc()
+    m.persistent(True)
+    _feed(m, range(1))
+    sd = m.state_dict()
+    incomplete = {k: v for k, v in sd.items() if k != "correct"}
+    incomplete["bogus"] = np.zeros(())
+    with pytest.raises(StateDictMismatchError) as err:
+        _acc().load_state_dict(incomplete, strict=True)
+    assert "correct" in str(err.value) and "bogus" in str(err.value)
+    # and nothing was loaded before the raise
+    fresh = _acc()
+    with pytest.raises(StateDictMismatchError):
+        fresh.load_state_dict(incomplete, strict=True)
+    np.testing.assert_array_equal(np.asarray(fresh._state["total"]), 0)
+    _acc().load_state_dict(sd, strict=True)  # complete dict passes
+
+
+def test_collection_load_state_dict_strict():
+    mc = MetricCollection({"a": _acc(), "p": Precision(num_classes=10, average="macro")})
+    mc.persistent(True)
+    _feed(mc, range(1))
+    sd = mc.state_dict()
+    mc2 = MetricCollection({"a": _acc(), "p": Precision(num_classes=10, average="macro")})
+    mc2.load_state_dict(sd, strict=True)  # a member's keys are not "unexpected"
+    broken = dict(sd)
+    broken.pop("a.correct")
+    broken["stray.key"] = np.zeros(())
+    with pytest.raises(StateDictMismatchError) as err:
+        mc2.load_state_dict(broken, strict=True)
+    assert "a.correct" in str(err.value) and "stray.key" in str(err.value)
+
+
+# ---------------------------------------------------------------------------
+# satellite: merge_state schema validation
+# ---------------------------------------------------------------------------
+
+
+def test_merge_state_schema_mismatch_names_leaves():
+    a = _feed(Precision(num_classes=10, average="macro"), range(1))
+    b = Precision(num_classes=5, average="macro")
+    b.update(jnp.asarray(PREDS[0, :, :5]), jnp.asarray(TARGET[0] % 5))
+    with pytest.raises(StateSchemaError) as err:
+        a.merge_state(b)
+    assert "tp" in str(err.value)  # the divergent leaf is named
+
+
+def test_merge_state_dict_missing_key():
+    a = _feed(_acc(), range(1))
+    with pytest.raises(StateSchemaError, match="missing"):
+        a.merge_state({"correct": np.zeros(())})
+
+
+def test_merge_state_cat_dtype_category_mismatch_refused():
+    """Float rows into an int cat buffer would silently truncate through
+    CatBuffer.append's astype — the validator refuses up front."""
+    from metrics_tpu import Metric
+
+    class _Cat(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("vals", [], dist_reduce_fx="cat")
+
+        def update(self, x):
+            self.vals.append(jnp.asarray(x))
+
+        def compute(self):
+            return jnp.concatenate([jnp.atleast_1d(v) for v in self.vals])
+
+    a = _Cat().with_capacity(16)
+    a.update(jnp.asarray([1, 2, 3], jnp.int32))
+    b = _Cat().with_capacity(16)
+    b.update(jnp.asarray([0.5, 0.25], jnp.float32))
+    with pytest.raises(StateSchemaError, match="dtype"):
+        a.merge_state(b)
+    # same-category precision moves stay legal promotion
+    c = _Cat().with_capacity(16)
+    c.update(jnp.asarray([1.0, 2.0], jnp.float16))
+    b.merge_state(c)
+    assert len(b._state["vals"]) == 4
+
+
+def test_grouped_sibling_checkpointer_fires(tmp_path):
+    """A checkpointer attached to a NON-leader grouped member must still
+    snapshot under collection dispatch (the leader runs the shared update)."""
+    mc = MetricCollection(
+        {"p": Precision(num_classes=10, average="macro"), "r": Recall(num_classes=10, average="macro")}
+    )
+    _feed(mc, range(1))
+    assert mc.compute_group_keys == [["p", "r"]]  # "p" is the leader
+    with mc["r"].checkpointer(str(tmp_path), every_n_updates=1, rank=0, world=1) as ck:
+        _feed(mc, [1])          # group update dispatches on "p"
+        mc(jnp.asarray(PREDS[2]), jnp.asarray(TARGET[2]))  # group forward
+    assert len(ck.snapshots) == 2
+    m2 = load_checkpoint(Recall(num_classes=10, average="macro"), str(tmp_path), rank=0, world=1)
+    np.testing.assert_array_equal(np.asarray(m2.compute()), np.asarray(mc["r"].compute()))
+
+
+def test_merge_state_cross_kind_still_legal():
+    # CatBuffer-mode and list-mode metrics merge across kinds (documented)
+    a = AUROC().with_capacity(128)
+    b = AUROC()
+    a.update(jnp.asarray(BPREDS[0]), jnp.asarray(BTARGET[0]))
+    b.update(jnp.asarray(BPREDS[1]), jnp.asarray(BTARGET[1]))
+    a.merge_state(b)
+    assert len(a._state["preds"]) == 64
+
+
+def test_merge_state_identical_schema_unchanged():
+    a = _feed(_acc(), range(1))
+    b = _feed(_acc(), [1])
+    a.merge_state(b)
+    expected = (np.argmax(PREDS[:2], -1) == TARGET[:2]).mean()
+    np.testing.assert_allclose(float(a.compute()), expected, atol=1e-6)
