@@ -3,12 +3,15 @@
 Default (driver contract): runs BASELINE config 1 and prints ONE JSON line
 ``{"metric", "value", "unit", "vs_baseline"}``.
 
-``python bench.py --all`` additionally runs configs 2-10 (one JSON line
-each; ``--config N`` runs a single one; see BASELINE.md for the config
-table and BENCH.md for recorded numbers; config 8 is the host-sync
-collective-fusion accounting added with the bucketed planner, config 9 the
-compute-group update/state dedup accounting, config 10 the preemption-safe
-checkpoint snapshot/restore latency + restore-after-kill equivalence).
+``python bench.py --all`` additionally runs configs 2-11 (one JSON line
+each; ``--config N`` runs selected ones — a comma-separated list like
+``--config 9,11`` runs several in one process sharing compile-cache warmth;
+see BASELINE.md for the config table and BENCH.md for recorded numbers;
+config 8 is the host-sync collective-fusion accounting added with the
+bucketed planner, config 9 the compute-group update/state dedup accounting,
+config 10 the preemption-safe checkpoint snapshot/restore latency +
+restore-after-kill equivalence, config 11 the compiled eager hot path —
+compiled vs eager step time, dispatch counts and bit-equality).
 
 Timing methodology (see BENCH.md): hot paths are timed **on-chip** by
 scanning K steps inside ONE jitted program (``lax.scan``) and dividing — a
@@ -1376,7 +1379,7 @@ def bench_config9() -> None:
             return jnp.asarray(np.stack([row.copy() for _ in range(W)]))
 
     def make(grouped: bool) -> MetricCollection:
-        return MetricCollection(
+        mc = MetricCollection(
             {
                 "prec": Precision(num_classes=NUM_CLASSES, average="macro"),
                 "rec": Recall(num_classes=NUM_CLASSES, average="macro"),
@@ -1385,6 +1388,13 @@ def bench_config9() -> None:
             },
             compute_groups=grouped,
         )
+        for m in mc.values():
+            # config 9 measures the EAGER grouped-vs-ungrouped dedup; under
+            # the compiled hot path (config 11's subject) the traced update
+            # is cached, so the _stat_scores_update counter below would
+            # count traces, not per-step dispatches
+            m.compiled_update = False
+        return mc
 
     rng = np.random.RandomState(0)
     preds = jnp.asarray(rng.rand(BATCH, NUM_CLASSES).astype(np.float32))
@@ -1575,6 +1585,201 @@ def bench_config10() -> None:
     )
 
 
+def bench_config11() -> None:
+    """Config 11: compiled eager hot path — compiled vs eager step time,
+    dispatch counts, and compiled ≡ eager bit-equality.
+
+    The ISSUE-5 acceptance measurement: the torchmetrics-style eager
+    ``update()`` surface auto-JITs into ONE donated-state XLA program per
+    step (`core/compiled.py`). A 4-metric stat-score collection
+    (Precision/Recall/F1/Specificity — one compute group) runs the same
+    batch stream with the compiled path pinned ON and pinned OFF, timing
+    the per-step wall clock and counting compiled dispatches via
+    `compile_stats()`. A CatBuffer curve collection (ROC/PRC/AP — the
+    declared side-effect-latch family) exercises the permanent fallback
+    path, and a fallback-triggering member (Accuracy) joins a mixed
+    collection to show the fused program shrinking around it. Asserts
+    (CI gates contract):
+
+    - compiled ≡ eager bit-identical state leaves and compute values on
+      every collection above (including the fallback and mixed ones);
+    - exactly 1 compiled dispatch per step for the grouped stat-score
+      collection AND for the ungrouped one (the collection-level fused
+      program covers all 4 members);
+    - the curve family records a fallback reason and issues 0 compiled
+      dispatches (graceful, silent-by-design fallback);
+    - compiled step time ≥ 10x faster than the eager baseline (CPU).
+
+    Emits `compiled_eager_step_us` with `vs_baseline` = eager/compiled;
+    dispatch counts, traces and fallback reasons ride the diagnostic line.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu import (
+        Accuracy,
+        AveragePrecision,
+        F1,
+        Precision,
+        PrecisionRecallCurve,
+        Recall,
+        ROC,
+        Specificity,
+    )
+    from metrics_tpu.core.collections import MetricCollection
+
+    B, STEPS, EQ_STEPS = 256, 30, 8
+    rng = np.random.RandomState(11)
+    preds = [jnp.asarray(rng.rand(B, NUM_CLASSES).astype(np.float32)) for _ in range(EQ_STEPS)]
+    target = [jnp.asarray(rng.randint(0, NUM_CLASSES, (B,))) for _ in range(EQ_STEPS)]
+
+    def make_stats(compiled, grouped=True) -> MetricCollection:
+        mc = MetricCollection(
+            {
+                "prec": Precision(num_classes=NUM_CLASSES, average="macro"),
+                "rec": Recall(num_classes=NUM_CLASSES, average="macro"),
+                "f1": F1(num_classes=NUM_CLASSES, average="macro"),
+                "spec": Specificity(num_classes=NUM_CLASSES, average="macro"),
+            },
+            compute_groups=grouped,
+        )
+        for m in mc.values():
+            m.compiled_update = compiled  # True = engage immediately (skip warm-up)
+        return mc
+
+    def total_dispatches(mc) -> int:
+        cs = mc.compile_stats()
+        return cs["collection"]["dispatches"] + sum(
+            s["dispatches"] for s in cs["members"].values()
+        )
+
+    def assert_equal(a, b, what) -> None:
+        for (k, ma), mb in zip(a.items(), b.values()):
+            for name in ma._state:
+                la = jax.tree_util.tree_leaves(ma._state[name])
+                lb = jax.tree_util.tree_leaves(mb._state[name])
+                assert len(la) == len(lb) and all(
+                    np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+                ), f"{what}: state {k}.{name} diverged compiled vs eager"
+        va, vb = a.compute(), b.compute()
+        for k in va:
+            la = jax.tree_util.tree_leaves(va[k])
+            lb = jax.tree_util.tree_leaves(vb[k])
+            assert len(la) == len(lb) and all(
+                np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+            ), f"{what}: value {k} diverged"
+
+    # ---- equality matrix: grouped + ungrouped stat-score collections ----
+    for grouped in (True, False):
+        eager, compiled = make_stats(False, grouped), make_stats(True, grouped)
+        for i in range(EQ_STEPS):
+            eager.update(preds[i], target[i])
+            compiled.update(preds[i], target[i])
+        assert_equal(eager, compiled, f"stat-scores grouped={grouped}")
+        if not grouped:
+            # collection-level fused program: 4 members, ONE dispatch per step
+            cs = compiled.compile_stats()
+            per_step = cs["collection"]["dispatches"] / EQ_STEPS
+            assert per_step == 1.0, f"ungrouped fused dispatches/step {per_step} != 1"
+            assert all(s["dispatches"] == 0 for s in cs["members"].values()), cs
+
+    # ---- fallback family: CatBuffer curve collection ----
+    def make_curves(compiled) -> MetricCollection:
+        mc = MetricCollection(
+            {
+                "roc": ROC().with_capacity(B * EQ_STEPS),
+                "prc": PrecisionRecallCurve().with_capacity(B * EQ_STEPS),
+                "ap": AveragePrecision().with_capacity(B * EQ_STEPS),
+            }
+        )
+        for m in mc.values():
+            m.compiled_update = compiled
+        return mc
+
+    eager_c, compiled_c = make_curves(False), make_curves(True)
+    bp = [jnp.asarray(rng.rand(B).astype(np.float32)) for _ in range(EQ_STEPS)]
+    bt = [jnp.asarray(rng.randint(0, 2, (B,))) for _ in range(EQ_STEPS)]
+    for i in range(EQ_STEPS):
+        eager_c.update(bp[i], bt[i])
+        compiled_c.update(bp[i], bt[i])
+    assert_equal(eager_c, compiled_c, "curve collection")
+    ccs = compiled_c.compile_stats()
+    assert total_dispatches(compiled_c) == 0, "fallback family must issue 0 compiled dispatches"
+    fallbacks = {
+        k: s["fallback"]["update"]
+        for k, s in ccs["members"].items()
+        if s["fallback"] and "update" in s["fallback"]
+    }
+    assert fallbacks, "curve collection recorded no fallback reason"
+
+    # ---- fallback-triggering member joining the collection ----
+    def make_mixed(compiled) -> MetricCollection:
+        mc = MetricCollection(
+            {
+                "prec": Precision(num_classes=NUM_CLASSES, average="macro"),
+                "rec": Recall(num_classes=NUM_CLASSES, average="macro"),
+                "acc": Accuracy(num_classes=NUM_CLASSES),
+            },
+            compute_groups=False,
+        )
+        for m in mc.values():
+            m.compiled_update = compiled
+        return mc
+
+    eager_m, compiled_m = make_mixed(False), make_mixed(True)
+    for i in range(EQ_STEPS):
+        eager_m.update(preds[i], target[i])
+        compiled_m.update(preds[i], target[i])
+    assert_equal(eager_m, compiled_m, "mixed collection with fallback member")
+    mcs = compiled_m.compile_stats()
+    assert mcs["members"]["acc"]["fallback"], "Accuracy should fall back (mode latch)"
+    assert mcs["collection"]["dispatches"] == EQ_STEPS, mcs["collection"]
+
+    # ---- step time + dispatch accounting (the headline numbers) ----
+    step_us = {}
+    disp_per_step = None
+    for mode in ("compiled", "eager"):
+        mc = make_stats(mode == "compiled")
+        mc.update(preds[0], target[0])  # warm: group plan (+ trace for compiled)
+        base = total_dispatches(mc)
+        jax.block_until_ready(mc["prec"]._state["tp"])
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            mc.update(preds[0], target[0])
+        jax.block_until_ready(mc["prec"]._state["tp"])
+        step_us[mode] = (time.perf_counter() - t0) / STEPS * 1e6
+        if mode == "compiled":
+            disp_per_step = (total_dispatches(mc) - base) / STEPS
+            stats_compiled = mc.compile_stats()
+
+    assert disp_per_step == 1.0, f"compiled dispatches/step {disp_per_step} != 1"
+    speedup = step_us["eager"] / step_us["compiled"]
+    assert speedup >= 10.0, (
+        f"compiled eager step only {speedup:.1f}x faster than eager "
+        f"({step_us['compiled']:.1f} vs {step_us['eager']:.1f} us/step); contract is >= 10x"
+    )
+
+    _diag(
+        config=11,
+        members=4,
+        batch=B,
+        step_us={m: round(v, 2) for m, v in step_us.items()},
+        compiled_dispatches_per_step=disp_per_step,
+        compiled_stats={
+            "collection": stats_compiled["collection"],
+            "leader": stats_compiled["members"]["f1"],
+        },
+        curve_fallback_reasons={k: v[:80] for k, v in fallbacks.items()},
+        equality="bit-identical (grouped, ungrouped, curve-fallback, mixed)",
+    )
+    _emit(
+        "compiled_eager_step_us",
+        round(step_us["compiled"], 2),
+        "us/step",
+        round(speedup, 3),
+    )
+
+
 def main() -> None:
     try:
         platform = _ensure_backend()
@@ -1600,15 +1805,17 @@ def main() -> None:
     except Exception:
         vs = None
     _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
-    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10}
+    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10, "11": bench_config11}
     if "--config" in sys.argv:
+        # comma-separated list (--config 9,11): related configs run in one
+        # process and share compile-cache warmth (CI gates contract)
         i = sys.argv.index("--config") + 1
-        key = sys.argv[i] if i < len(sys.argv) else None
-        if key not in extra:
-            print(json.dumps({"diagnostic": f"--config takes one of {sorted(extra)} (config 1 always runs); got {key!r}"}), file=sys.stderr)
-            wanted = []
-        else:
-            wanted = [extra[key]]
+        raw = sys.argv[i] if i < len(sys.argv) else None
+        keys = [k.strip() for k in raw.split(",") if k.strip()] if raw else []
+        bad = [k for k in keys if k not in extra]
+        if bad or not keys:
+            print(json.dumps({"diagnostic": f"--config takes a comma-separated list from {sorted(extra)} (config 1 always runs); got {raw!r}"}), file=sys.stderr)
+        wanted = [extra[k] for k in keys if k in extra]
     elif "--all" in sys.argv:
         wanted = list(extra.values())
     else:

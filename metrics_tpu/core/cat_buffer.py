@@ -11,7 +11,10 @@ batch count retraces the jitted step, and collectives need static shapes
 
 - ``append`` is a ``lax.dynamic_update_slice`` — static shapes, O(1) memory,
   the jitted update step never retraces as data accumulates and the buffer can
-  be donated.
+  be donated. The compiled eager hot path (``core/compiled.py``) relies on
+  both properties: a CatBuffer-state metric's ``update()`` auto-JITs into one
+  donated-buffer program per step, where a growing list state would retrace
+  every step (and is therefore routed to eager).
 - cross-device sync is a plain ``lax.all_gather`` of buffers + counts
   followed by a static-shape compaction of contiguous
   ``dynamic_update_slice`` copies (:func:`sync_cat_buffer_in_jit`) — the
@@ -207,6 +210,24 @@ class CatBuffer:
     # -- functional structure -------------------------------------------
     def copy(self) -> "CatBuffer":
         return CatBuffer(self.capacity, self.buffer, self.count, self.overflowed)
+
+    def fresh_copy(self) -> "CatBuffer":
+        """A copy whose array leaves are *newly allocated* buffers.
+
+        Unlike :meth:`copy` (an O(1) wrapper copy sharing the immutable
+        leaves), every leaf here is privately owned by the result — the
+        copy-on-first-donation primitive of the compiled eager hot path
+        (``core/compiled.py``): a donated buffer is invalidated in place, so
+        a CatBuffer about to enter a ``donate_argnums`` program must not
+        share leaves with defaults, compute-group siblings, sync caches or
+        user-held references.
+        """
+        return CatBuffer(
+            self.capacity,
+            None if self.buffer is None else jnp.array(self.buffer, copy=True),
+            jnp.array(self.count, copy=True),
+            jnp.array(self.overflowed, copy=True),
+        )
 
     def reset(self) -> "CatBuffer":
         return CatBuffer(self.capacity)

@@ -23,14 +23,25 @@ from contextlib import contextmanager
 from copy import deepcopy
 from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple, Union
 
+import jax
 import numpy as np
 
 from metrics_tpu.core.cat_buffer import CatBuffer
+from metrics_tpu.core.compiled import (
+    CompiledDispatcher,
+    compiled_update_enabled,
+    compiled_warmup,
+    dispatch_program,
+    probe_traceable,
+    rebuild_call,
+    split_call,
+)
 from metrics_tpu.core.metric import (
     _ComputeGroup,
     _ON_ERROR_MODES,
     Metric,
     _copy_state_value,
+    _raise_on_catbuffer_overflow,
 )
 from metrics_tpu.parallel.health import FUSED_KEY_SEP as _FUSED_KEY_SEP
 from metrics_tpu.utils.data import is_traced
@@ -520,7 +531,11 @@ class MetricCollection(dict):
 
     def update(self, *args: Any, **kwargs: Any) -> None:  # type: ignore[override]
         self._ensure_groups()
-        handled: set = set()
+        # the collection-level compiled step: every compiled-eligible
+        # dispatch unit (solo member or compute-group leader) updates inside
+        # ONE donated-state XLA program; whatever it could not take stays on
+        # the per-member loop below (which may still compile per member)
+        handled: set = self._maybe_compiled_collection_update(args, kwargs)
         for m in self.values():
             if id(m) in handled:
                 continue
@@ -533,6 +548,269 @@ class MetricCollection(dict):
         ckpt = getattr(self, "_auto_checkpointer", None)
         if ckpt is not None:
             ckpt.after_update(self)
+
+    # ---------------- compiled eager hot path ----------------
+
+    def _compiled_dispatcher(self) -> CompiledDispatcher:
+        disp = self.__dict__.get("_compiled")
+        if disp is None:
+            disp = CompiledDispatcher("MetricCollection")
+            self.__dict__["_compiled"] = disp
+        return disp
+
+    def compile_stats(self) -> Dict[str, Any]:
+        """Compiled-eager observability for the collection and its members.
+
+        ``{"collection": {...}, "members": {key: {...}}}`` — the collection
+        entry counts the fused multi-unit programs (one XLA dispatch updating
+        every eligible compute-group leader together, plus the compiled group
+        ``forward`` programs); member entries count their own solo programs
+        and record per-instance fallback reasons. See
+        :meth:`Metric.compile_stats`.
+        """
+        disp = self.__dict__.get("_compiled")
+        coll = (
+            disp.stats()
+            if disp is not None
+            else {"traces": 0, "dispatches": 0, "cache_hits": 0, "steps_seen": 0, "fallback": None}
+        )
+        return {"collection": coll, "members": {k: m.compile_stats() for k, m in super().items()}}
+
+    def _compiled_units(self) -> List[Tuple[str, Metric, Tuple[Metric, ...]]]:
+        """One ``(key, leader, members)`` triple per dispatch unit — solo
+        members stand alone, compute groups dispatch through their leader."""
+        units: List[Tuple[str, Metric, Tuple[Metric, ...]]] = []
+        seen: set = set()
+        for k, m in super().items():
+            g = m._compute_group
+            if g is None:
+                units.append((k, m, (m,)))
+            elif id(g) not in seen:
+                seen.add(id(g))
+                units.append((k, m, tuple(g.members)))
+        return units
+
+    def _maybe_compiled_collection_update(self, args: Tuple, kwargs: Dict[str, Any]) -> set:
+        """Fuse all compiled-eligible units' updates into ONE XLA dispatch.
+
+        Returns the set of handled member ids (empty when nothing fused).
+        With fewer than two eligible units there is nothing to fuse beyond
+        what the member-level path already compiles — the per-member loop
+        (whose dispatch hits the same program cache as a direct
+        ``m.update()``) is left to it, so the same step is never compiled
+        twice. A fallback-triggering member simply stays on the eager loop:
+        results are identical, the fused program just shrinks around it.
+        """
+        if not compiled_update_enabled():
+            return set()
+        eligible: List[Tuple[str, Metric, Tuple[Metric, ...]]] = []
+        force = False
+        for k, m, members in self._compiled_units():
+            knob = getattr(m, "compiled_update", None)
+            if knob is False:
+                continue
+            disp = m._compiled_dispatcher()
+            if "update" in disp.fallback or not m._compiled_static_ok("update", disp):
+                continue
+            force = force or knob is True
+            eligible.append((k, m, members))
+        if len(eligible) < 2:
+            return set()
+        coll_disp = self._compiled_dispatcher()
+        coll_disp.steps_seen += 1
+        if "update" in coll_disp.fallback:
+            return set()
+        if not force and coll_disp.steps_seen <= compiled_warmup():
+            return set()
+        if coll_disp.storming("update"):
+            return set()
+        try:
+            treedef, dyn_ix, statics, dynamic = split_call(args, kwargs)
+        except TypeError:
+            coll_disp.mark_fallback("update", "update arguments contain unhashable non-array values")
+            return set()
+        pairs = [(k, m) for k, m, _ in eligible]
+        key = ("update", tuple(k for k, _ in pairs), treedef, dyn_ix, statics)
+
+        def build():
+            def traced(states, dyn):
+                a, kw = rebuild_call(treedef, dyn_ix, statics, dyn)
+                return {
+                    k: m.pure_update(states[k], *a, **m._filtered_kwargs(kw)) for k, m in pairs
+                }
+
+            return traced
+
+        if not coll_disp.probed(key):
+            reason = probe_traceable(
+                build(),
+                {k: dict(m._state) for k, m in pairs},
+                dynamic,
+                [m for _, m in pairs],
+            )
+            if reason is not None:
+                # attribute the failure: probe each unit alone, so one
+                # untraceable member marks only ITSELF fallback — the next
+                # step's eligibility pass then fuses the remaining units
+                # under a new key (the fused program shrinks around it)
+                culprits = 0
+                for k, m in pairs:
+
+                    def solo(state, dyn, _m=m):
+                        a, kw = rebuild_call(treedef, dyn_ix, statics, dyn)
+                        return _m.pure_update(state, *a, **_m._filtered_kwargs(kw))
+
+                    solo_reason = probe_traceable(solo, dict(m._state), dynamic, [m])
+                    if solo_reason is not None:
+                        m._compiled_dispatcher().mark_fallback("update", solo_reason)
+                        culprits += 1
+                if culprits == 0:
+                    # no individual culprit: the combination itself failed —
+                    # only then is the collection-level program hopeless
+                    coll_disp.mark_fallback("update", reason)
+                return set()
+            coll_disp.mark_probed(key)
+        if any(p._is_synced for _, _, members in eligible for p in members):
+            raise MetricsTPUUserError(
+                "The Metric shouldn't be synced when performing ``update``. "
+                "HINT: Did you forget to call ``unsync``?"
+            )
+        prog = coll_disp.program(key, build)
+        for _, m, _ in eligible:
+            m._ensure_donation_safe()
+        states = {k: dict(m._state) for k, m in pairs}
+        handled_ok, new_states = dispatch_program(coll_disp, "update", prog, states, dynamic)
+        if not handled_ok:
+            return set()
+        handled: set = set()
+        for k, m, members in eligible:
+            st = m._state
+            ns = new_states[k]
+            for name in st:
+                st[name] = ns[name]
+            object.__setattr__(m, "_donation_ready", True)
+            try:
+                _raise_on_catbuffer_overflow(st, type(m).__name__)
+            except MetricsTPUUserError:
+                # mirror the eager failure semantics: a raising group update
+                # disbands the group so no later relink clobbers siblings
+                if m._compute_group is not None:
+                    self._break_group(m._compute_group)
+                raise
+            m._update_count = getattr(m, "_update_count", 0) + 1
+            m._update_called = True
+            m._computed = None
+            for p in members:
+                handled.add(id(p))
+                if p is m:
+                    continue
+                p._computed = None
+                p._update_called = True
+                p._update_count = m._update_count
+            g = m._compute_group
+            if g is not None:
+                self._relink_group(g, m)
+            for p in members:
+                ckpt = getattr(p, "_auto_checkpointer", None)
+                if ckpt is not None:
+                    ckpt.after_update(p)
+        return handled
+
+    def _maybe_compiled_group_forward(
+        self, group: _ComputeGroup, source: Metric, args: Tuple, kwargs: Dict[str, Any]
+    ) -> Optional[Dict[int, Any]]:
+        """Compiled group-level ``forward``: ONE donated-state XLA program
+        runs the group's single update on a fresh batch state, every
+        member's batch-local compute (XLA CSEs the shared stat work), and
+        the one merge back into the shared accumulation. Returns
+        ``{id(member): batch_value}`` or ``None`` (eager path)."""
+        knob = getattr(source, "compiled_update", None)
+        if knob is False or not compiled_update_enabled():
+            return None
+        members = list(group.members)
+        if any(getattr(p, "compiled_update", None) is False for p in members):
+            return None
+        if any(p.dist_sync_on_step or getattr(p, "check_finite", False) for p in members):
+            return None
+        disp = source._compiled_dispatcher()
+        if "forward" in disp.fallback:
+            return None
+        if knob is not True and disp.steps_seen <= compiled_warmup():
+            return None
+        if not source._compiled_static_ok("forward", disp):
+            return None
+        coll_disp = self._compiled_dispatcher()
+        member_keys = tuple(k for k, m in super().items() if m in members)
+        fkind = "forward[" + ",".join(member_keys) + "]"
+        if fkind in coll_disp.fallback:
+            return None
+        if coll_disp.storming(fkind):
+            return None
+        try:
+            treedef, dyn_ix, statics, dynamic = split_call(args, kwargs)
+        except TypeError:
+            coll_disp.mark_fallback(fkind, "forward arguments contain unhashable non-array values")
+            return None
+        key = (fkind, treedef, dyn_ix, statics)
+        on_step = [p for p in members if p.compute_on_step]
+        # forward's update precedes the batch computes: mark every member
+        # updated before tracing, so the compute wrapper's not-yet-updated
+        # warning cannot fire from the trace (the eager path sets this at
+        # the same point via the inner update)
+        for p in members:
+            p._update_called = True
+
+        def build():
+            def traced(state, dyn):
+                a, kw = rebuild_call(treedef, dyn_ix, statics, dyn)
+                batch = source.pure_update(
+                    source._batch_default_state(), *a, **source._filtered_kwargs(kw)
+                )
+                values = tuple(p.pure_compute(batch) for p in on_step)
+                return source.merge_states(state, batch), values
+
+            return traced
+
+        if not coll_disp.probed(key):
+            reason = probe_traceable(build(), dict(source._state), dynamic, members)
+            if reason is not None:
+                coll_disp.mark_fallback(fkind, reason)
+                return None
+            coll_disp.mark_probed(key)
+        prog = coll_disp.program(key, build)
+        source._ensure_donation_safe()
+        handled_ok, out = dispatch_program(coll_disp, fkind, prog, dict(source._state), dynamic)
+        if handled_ok is False:
+            return None
+        new_state, values = out
+        st = source._state
+        for name in st:
+            st[name] = new_state[name]
+        object.__setattr__(source, "_donation_ready", True)
+        try:
+            _raise_on_catbuffer_overflow(st, type(source).__name__)
+        except MetricsTPUUserError:
+            self._break_group(group)  # mirror the eager forward failure path
+            raise
+        source._update_count = getattr(source, "_update_count", 0) + 1
+        for p in members:
+            p._update_called = True
+            p._computed = None
+            p._update_count = source._update_count
+        self._relink_group(group, source)
+        out: Dict[int, Any] = {}
+        values_it = iter(values)
+        for p in members:
+            if p.compute_on_step:
+                p._forward_cache = next(values_it)
+                out[id(p)] = p._forward_cache
+            else:
+                out[id(p)] = None
+        for p in members:
+            ckpt = getattr(p, "_auto_checkpointer", None)
+            if ckpt is not None:
+                ckpt.after_update(p)
+        return out
 
     def _group_update(
         self, group: _ComputeGroup, source: Metric, args: Tuple, kwargs: Dict[str, Any]
@@ -587,6 +865,9 @@ class MetricCollection(dict):
         if all(not p.compute_on_step for p in group.members):
             self._group_update(group, source, args, kwargs)
             return {id(p): None for p in group.members}
+        compiled = self._maybe_compiled_group_forward(group, source, args, kwargs)
+        if compiled is not None:
+            return compiled
         accumulated = {k: _copy_state_value(v) for k, v in source._state.items()}
         can_merge = source._can_merge()
         # the inner updates run on a transient batch state: a member-level
@@ -934,6 +1215,10 @@ class MetricCollection(dict):
             for p in peers:
                 p._cache = {k: _copy_state_value(v) for k, v in m._cache.items()}
                 p._sync_degraded = False
+                # the synced leaves alias the owner's (and the caches hold the
+                # pre-sync arrays): donation must copy first — mirrors what
+                # Metric._restore guarantees for the owner
+                object.__setattr__(p, "_donation_ready", False)
                 for name in m._state:
                     p._state[name] = m._state[name]
                 p._is_synced = True

@@ -22,6 +22,14 @@ re-designed around JAX's functional model:
 - **Sync state machine** (``_is_synced`` with guarded transitions raising
   on double-sync / unsync-without-sync / update-while-synced) mirrors
   reference ``metric.py:184-188,271-272,299-303``.
+- **Compiled eager hot path.** The stateful ``update``/``forward`` surface
+  auto-JITs (``core/compiled.py``): after a short warm-up, eager dispatches
+  route through a cached ``jax.jit(pure_update)`` program with the state
+  buffers donated — ONE XLA dispatch per step instead of one per jnp op,
+  bit-identical to eager. Metrics whose update is untraceable or carries
+  side-effect latches are detected at first trace and permanently routed to
+  the eager path (``METRICS_TPU_COMPILED_UPDATE=0`` / ``compiled_update``
+  are the knobs; see ``docs/performance.md``).
 """
 import functools
 import warnings
@@ -34,6 +42,15 @@ import numpy as np
 from jax import Array
 
 from metrics_tpu.core.cat_buffer import CatBuffer
+from metrics_tpu.core.compiled import (
+    CompiledDispatcher,
+    compiled_update_enabled,
+    compiled_warmup,
+    dispatch_program,
+    probe_traceable,
+    rebuild_call,
+    split_call,
+)
 from metrics_tpu.parallel.health import NONFINITE_STATE
 from metrics_tpu.parallel.sync import (
     host_sync_state,
@@ -152,6 +169,29 @@ def _copy_state_value(v: Any) -> Any:
     return v
 
 
+def _raise_on_catbuffer_overflow(state: Dict[str, Any], label: str) -> None:
+    """Keep eager overflow semantics on the compiled hot path: an eager
+    ``CatBuffer.append`` raises on a concrete overflow, but inside the
+    compiled program the append clamps and latches the ``overflowed`` flag
+    (the in-jit contract). After each compiled dispatch the flag is read
+    back and re-raised eagerly, so the hot loop still fails at the step the
+    overflow happened — the buffer already holds the clamped rows and the
+    latched flag (unlike eager, which refuses the write), which the message
+    says. One scalar readback per CatBuffer state per step; metrics without
+    CatBuffer states skip this entirely."""
+    for name, v in state.items():
+        if isinstance(v, CatBuffer) and not is_traced(v.overflowed) and bool(
+            np.asarray(v.overflowed)
+        ):
+            raise MetricsTPUUserError(
+                f"CatBuffer state {name!r} of {label} overflowed its capacity "
+                f"{v.capacity} during a compiled update: the traced append clamps "
+                "and latches instead of raising mid-program, so the buffer now "
+                "holds clamped rows and a latched overflow flag. Construct the "
+                "metric with a larger `with_capacity(...)` and re-run."
+            )
+
+
 def _value_spec(x: Any) -> Tuple[str, Tuple[int, ...]]:
     """(dtype string, shape) of an array-like without materializing it —
     works for tracers (aval attributes), jnp/np arrays, and python scalars."""
@@ -247,16 +287,13 @@ class _ComputeGroup:
 
 
 def _fresh_state_value(v: Any) -> Any:
-    """A deep, newly-allocated copy of a state default (see _default_state)."""
+    """A deep, newly-allocated copy of a state value — used for fresh
+    defaults (see ``_default_state``) and for copy-on-first-donation before
+    a compiled dispatch (see ``Metric._ensure_donation_safe``)."""
     if isinstance(v, list):
         return [jnp.array(x, copy=True) for x in v]
     if isinstance(v, CatBuffer):
-        return CatBuffer(
-            v.capacity,
-            None if v.buffer is None else jnp.array(v.buffer, copy=True),
-            jnp.array(v.count, copy=True),
-            jnp.array(v.overflowed, copy=True),
-        )
+        return v.fresh_copy()
     return jnp.array(v, copy=True)
 
 
@@ -344,6 +381,25 @@ class Metric:
         sync_timeout: watchdog timeout (seconds) for this metric's host
             collectives; ``None`` uses the ``METRICS_TPU_SYNC_TIMEOUT_S``
             env knob (default 600), ``0`` disables the watchdog.
+        compiled_update: per-metric override of the compiled eager hot path
+            (see the :attr:`compiled_update` attribute): ``None`` follows
+            the ``METRICS_TPU_COMPILED_UPDATE`` env knob, ``False`` keeps
+            the per-op eager path, ``True`` compiles from the first update.
+
+    **Compiled eager hot path.** After a short warm-up (the path never taxes
+    one-shot workloads with compile time), eager ``update``/``forward``
+    calls route through a cached ``jax.jit(pure_update)`` program with the
+    state buffers donated: one XLA dispatch per step, accumulators updated
+    in place, results bit-identical to eager. ``forward`` fuses update +
+    batch-local compute + ``merge_states`` into the same single program.
+    Metrics whose update cannot trace (data-dependent python control flow)
+    or latches instance attributes (the declared ``_group_shared_attrs``
+    families — Accuracy's input mode, the curve family's inferred
+    ``num_classes``) are detected at first trace and permanently routed to
+    the eager path for that instance; :meth:`compile_stats` reports traces,
+    cache hits and the fallback reason. Ragged tail batches simply retrace
+    once per new shape (cached across epochs); sustained shape churn emits
+    a one-time diagnostic. See ``docs/performance.md``.
     """
 
     #: Whether the metric value is differentiable w.r.t. its float inputs.
@@ -362,6 +418,24 @@ class Metric:
     #: attribute so it can be flipped on any constructed metric; results are
     #: bit-identical either way (``parallel/bucketing.py``).
     sync_fused: Optional[bool] = None
+
+    #: Per-metric override of the compiled eager hot path (auto-JIT
+    #: ``update``/``forward`` — ``core/compiled.py``): ``None`` follows the
+    #: ``METRICS_TPU_COMPILED_UPDATE`` env knob (default on, engaging after
+    #: a ``METRICS_TPU_COMPILED_WARMUP``-step warm-up), ``False`` forces the
+    #: per-op eager path, ``True`` compiles from the first update. Plain
+    #: attribute so it can be flipped on any constructed metric; results are
+    #: bit-identical either way (the compiled ≡ eager contract).
+    compiled_update: Optional[bool] = None
+
+    #: Donation safety latch for the compiled hot path: ``True`` only while
+    #: every array leaf of ``_state`` is a buffer the last compiled dispatch
+    #: produced (and nothing else could be holding — reads and restores
+    #: clear it). When ``False``, the next compiled dispatch replaces the
+    #: leaves with fresh private copies before donating, so donation can
+    #: never invalidate aliased defaults, jnp constant-cache sharing,
+    #: compute-group siblings, a user-held reference, or the pre-sync cache.
+    _donation_ready: bool = False
 
     #: Compute-group link (set by ``MetricCollection`` when this metric is
     #: grouped with schema/update-identical siblings; ``None`` = ungrouped).
@@ -389,10 +463,13 @@ class Metric:
         check_finite: bool = False,
         sync_on_error: str = "raise",
         sync_timeout: Optional[float] = None,
+        compiled_update: Optional[bool] = None,
     ) -> None:
         # bypass custom __setattr__ while bootstrapping
         object.__setattr__(self, "_state", {})
         object.__setattr__(self, "_defaults", {})
+        if compiled_update is not None:
+            self.compiled_update = compiled_update
         self._reductions: Dict[str, Any] = {}
         self._persistent: Dict[str, bool] = {}
         self.compute_on_step = compute_on_step
@@ -458,6 +535,9 @@ class Metric:
         self._reductions[name] = dist_reduce_fx
         self._persistent[name] = persistent
         self._state[name] = _copy_state_value(default)
+        # the fresh state leaf aliases the default (and possibly jnp's
+        # constant cache): the next compiled dispatch must copy before donating
+        object.__setattr__(self, "_donation_ready", False)
 
     def with_capacity(self, capacity: int) -> "Metric":
         """Convert every list ("cat") state into a fixed-capacity
@@ -471,6 +551,7 @@ class Metric:
         ``dim_zero_cat`` dispatch on the state type. Returns ``self``.
         """
         self._group_detach_if_stray()
+        object.__setattr__(self, "_donation_ready", False)
         for name, default in self._defaults.items():
             if isinstance(default, list):
                 if default or (isinstance(self._state.get(name), list) and self._state[name]):
@@ -609,7 +690,12 @@ class Metric:
         group.members[:] = [m for m in group.members if m is not self]
         object.__setattr__(self, "_compute_group", None)
         # private copies of mutable containers; array leaves are immutable
-        # and stay shared until the next reassignment (true copy-on-write)
+        # and stay shared until the next reassignment (true copy-on-write).
+        # The shared arrays now have an out-of-group alias, so neither side
+        # may donate them until it has re-copied (compiled hot path).
+        object.__setattr__(self, "_donation_ready", False)
+        for m in group.members:
+            object.__setattr__(m, "_donation_ready", False)
         self._state = {k: _copy_state_value(v) for k, v in self._state.items()}
         if len(group.members) < 2:
             for m in group.members:
@@ -618,8 +704,19 @@ class Metric:
 
     def __getattr__(self, name: str) -> Any:
         # only called when normal lookup fails
-        state = object.__getattribute__(self, "__dict__").get("_state")
+        d = object.__getattribute__(self, "__dict__")
+        state = d.get("_state")
         if state is not None and name in state:
+            # the handed-out reference may outlive this call: a compiled
+            # dispatch must not donate (invalidate) the buffer behind it.
+            # Tracer reads inside pure/compiled traces don't escape.
+            if not d.get("_pure_mode", False):
+                group = d.get("_compute_group")
+                if group is not None:
+                    for m in group.members:
+                        object.__setattr__(m, "_donation_ready", False)
+                elif d.get("_donation_ready", False):
+                    object.__setattr__(self, "_donation_ready", False)
             return state[name]
         raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
 
@@ -633,6 +730,10 @@ class Metric:
                 # revert it when re-linking the shared views
                 self._group_detach_if_stray()
                 state = self.__dict__["_state"]  # detach swaps the dict
+            # the assigned value may alias anything (a user array, another
+            # state, a default): copy before the next donating dispatch
+            if self.__dict__.get("_donation_ready", False):
+                object.__setattr__(self, "_donation_ready", False)
             state[name] = value
         else:
             object.__setattr__(self, name, value)
@@ -663,6 +764,10 @@ class Metric:
             self.update(*args, **kwargs)
             return None
 
+        handled, value = self._maybe_compiled_forward(args, kwargs)
+        if handled:
+            return value
+
         accumulated = {k: _copy_state_value(v) for k, v in self._state.items()}
         update_count_supported = self._can_merge()
         # the auto-checkpointer must not fire off the transient batch state
@@ -686,8 +791,13 @@ class Metric:
             finally:
                 self._to_sync = True
             self._computed = None
-            # the wrapper's sync_context restored the (unsynced) batch state
-            batch_state = {k: _copy_state_value(v) for k, v in self._state.items()}
+            if self.dist_sync_on_step:
+                # the compute wrapper's sync_context may have synced and
+                # restored: re-snapshot the (unsynced) batch state. On the
+                # no-sync path (`_to_sync` was False) the wrapper cannot have
+                # touched state, so the first snapshot is still exact — skip
+                # the redundant full-state copy
+                batch_state = {k: _copy_state_value(v) for k, v in self._state.items()}
 
             if update_count_supported:
                 merged = self.merge_states(accumulated, batch_state)
@@ -931,12 +1041,15 @@ class Metric:
     def pure_compute(self, state: Dict[str, Any]) -> Any:
         """Pure functional compute over an explicit state pytree."""
         saved, saved_computed = self._state, self._computed
+        saved_pure = self.__dict__.get("_pure_mode", False)
         self._state = {k: _copy_state_value(v) for k, v in state.items()}
         self._computed = None
+        object.__setattr__(self, "_pure_mode", True)
         try:
             return self.compute()
         finally:
             self._state, self._computed = saved, saved_computed
+            object.__setattr__(self, "_pure_mode", saved_pure)
 
     def pure_sync(
         self, state: Dict[str, Any], axis_name: Optional[Any] = None, fused: bool = False
@@ -975,6 +1088,237 @@ class Metric:
         value = self.pure_compute(value_state)
         new_state = self.merge_states(state, batch_state)
         return new_state, value
+
+    # ------------------------------------------------------------------
+    # compiled eager hot path (auto-JIT update/forward, donated state)
+    # ------------------------------------------------------------------
+
+    def _compiled_dispatcher(self) -> CompiledDispatcher:
+        disp = self.__dict__.get("_compiled")
+        if disp is None:
+            disp = CompiledDispatcher(type(self).__name__)
+            object.__setattr__(self, "_compiled", disp)
+        return disp
+
+    def compile_stats(self) -> Dict[str, Any]:
+        """Observability for the compiled eager hot path.
+
+        Returns ``{"traces", "dispatches", "cache_hits", "steps_seen",
+        "fallback"}``: ``traces`` counts XLA (re)compilations — a growing
+        number under a steady workload means shape churn (ragged batches)
+        is recompiling instead of hitting the cache; ``dispatches`` counts
+        compiled executions (``cache_hits = dispatches - traces``);
+        ``steps_seen`` counts eager steps observed (the warm-up gate);
+        ``fallback`` maps ``"update"``/``"forward"`` to the reason this
+        instance was routed to the per-op eager path, or is ``None`` while
+        the compiled path is (still) available. Surfaced per metric in
+        ``bench.py`` diagnostics (config 11).
+        """
+        disp = self.__dict__.get("_compiled")
+        if disp is None:
+            return {
+                "traces": 0,
+                "dispatches": 0,
+                "cache_hits": 0,
+                "steps_seen": 0,
+                "fallback": None,
+            }
+        return disp.stats()
+
+    def _nested_metric_attrs(self) -> List[str]:
+        """Instance attributes holding other Metric objects (one container
+        level deep) — wrapper/compositional patterns whose ``update``
+        delegates eagerly and therefore must never be traced from here."""
+        out: List[str] = []
+        for k, v in self.__dict__.items():
+            if isinstance(v, Metric):
+                out.append(k)
+            elif isinstance(v, (list, tuple)) and any(isinstance(x, Metric) for x in v):
+                out.append(k)
+            elif isinstance(v, dict) and any(isinstance(x, Metric) for x in v.values()):
+                out.append(k)
+        return out
+
+    def _compiled_static_fallback(self, kind: str) -> Optional[str]:
+        """Statically-known reasons ``kind`` can never compile for this
+        instance (``None`` = none; the trace probe still has the last word).
+        These are documented design exclusions, so marking them does not
+        emit the fallback diagnostic."""
+        if not self._defaults:
+            return "metric declares no states (update composes or delegates; nothing to compile)"
+        shared = type(self)._group_shared_attrs
+        if shared:
+            return (
+                f"update maintains declared side-effect attribute(s) {shared} "
+                "(input-mode / inferred-num_classes latch) that a compiled "
+                "replay would skip"
+            )
+        for name, default in self._defaults.items():
+            if isinstance(default, list):
+                return (
+                    f"list state {name!r} grows every step and would retrace every "
+                    "step — use with_capacity() for a fixed-shape CatBuffer"
+                )
+        nested = self._nested_metric_attrs()
+        if nested:
+            return f"instance holds nested Metric attribute(s) {nested}; update may delegate to them"
+        if kind == "forward":
+            if not self._can_merge():
+                return "forward uses the non-mergeable double-update replay"
+            if self.dist_sync_on_step:
+                return "dist_sync_on_step runs a host sync between update and compute"
+            if getattr(self, "check_finite", False):
+                return (
+                    "check_finite raises eagerly at forward's compute step; only "
+                    "the inner update compiles"
+                )
+        return None
+
+    def _compiled_gate(self, kind: str) -> Optional[CompiledDispatcher]:
+        """Shared cheap gate for one eager dispatch: returns the dispatcher
+        when the compiled path should be attempted, else ``None``. Counts
+        warm-up steps for ``kind == "update"`` (forward's inner eager update
+        already counts the step)."""
+        knob = getattr(self, "compiled_update", None)
+        if knob is False or not compiled_update_enabled():
+            return None
+        if self.__dict__.get("_pure_mode", False):
+            # an EAGER pure_update()/pure_forward() swapped _state to leaves
+            # aliasing the caller's explicit state pytree; the _donation_ready
+            # latch describes the stateful accumulation, not this swap, so a
+            # donating dispatch here could consume the caller's arrays (or,
+            # after the restore, leave a stale latch over aliased defaults).
+            # The pure API is the user's own jit seam — stay eager under it.
+            return None
+        disp = self._compiled_dispatcher()
+        if kind == "update":
+            disp.steps_seen += 1
+        if kind in disp.fallback:
+            return None
+        if knob is not True and disp.steps_seen <= compiled_warmup():
+            return None
+        if not self._compiled_static_ok(kind, disp):
+            return None
+        return disp
+
+    def _compiled_static_ok(self, kind: str, disp: CompiledDispatcher) -> bool:
+        """:meth:`_compiled_static_fallback`, evaluated once per (instance,
+        kind) at the first engaged dispatch — the conditions are
+        construction-time facts (declared states and latches, merge/sync
+        config), and re-scanning them every hot-loop step is measurable."""
+        marker = ("static_ok", kind)
+        if disp.probed(marker):
+            return True
+        reason = self._compiled_static_fallback(kind)
+        if reason is not None:
+            disp.mark_fallback(kind, reason, warn=False)
+            return False
+        disp.mark_probed(marker)
+        return True
+
+    def _compiled_dispatch(self, kind: str, args: Tuple, kwargs: Dict[str, Any]):
+        """Run one eager ``update``/``forward`` as a single donated-state XLA
+        program. Returns ``(handled, batch_value)``; ``handled=False`` means
+        the caller must take the eager path (the reason has been recorded).
+
+        The traced computation is exactly the eager one: ``pure_update``
+        invokes the wrapped ``update`` (screening, dtype persistence and
+        CatBuffer-default materialization included), ``forward`` adds the
+        batch-local ``pure_compute`` and the ``merge_states`` fold — so
+        compiled ≡ eager holds leaf for leaf.
+        """
+        disp = self._compiled_dispatcher()
+        if disp.storming(kind):
+            return False, None
+        try:
+            treedef, dyn_ix, statics, dynamic = split_call(args, kwargs)
+        except TypeError:
+            disp.mark_fallback(kind, f"{kind} arguments contain unhashable non-array values")
+            return False, None
+        key = (kind, treedef, dyn_ix, statics)
+
+        def build() -> Callable:
+            if kind == "update":
+
+                def traced(state, dyn):
+                    a, kw = rebuild_call(treedef, dyn_ix, statics, dyn)
+                    return self.pure_update(state, *a, **kw)
+
+            else:
+
+                def traced(state, dyn):
+                    a, kw = rebuild_call(treedef, dyn_ix, statics, dyn)
+                    batch = self.pure_update(self._batch_default_state(), *a, **kw)
+                    value = self.pure_compute(batch)
+                    return self.merge_states(state, batch), value
+
+            return traced
+
+        if not disp.probed(key):
+            reason = probe_traceable(build(), dict(self._state), dynamic, [self])
+            if reason is not None:
+                disp.mark_fallback(kind, reason)
+                return False, None
+            disp.mark_probed(key)
+        prog = disp.program(key, build)
+        self._ensure_donation_safe()
+        handled, out = dispatch_program(disp, kind, prog, dict(self._state), dynamic)
+        if not handled:
+            return False, None
+        new_state, value = (out, None) if kind == "update" else out
+        st = self._state
+        for name in st:
+            st[name] = new_state[name]
+        # the outputs are buffers this dispatch owns outright: the next one
+        # may donate them without a protective copy
+        object.__setattr__(self, "_donation_ready", True)
+        _raise_on_catbuffer_overflow(st, type(self).__name__)
+        return True, value
+
+    def _ensure_donation_safe(self) -> None:
+        """Copy-on-first-donation: replace every state leaf with a private
+        fresh buffer unless the previous compiled dispatch already owns them
+        (see :attr:`_donation_ready`). This is what makes donation safe
+        against aliased defaults, jnp's constant cache, compute-group
+        sharing, sync caches and user-held references — at the cost of one
+        state copy per eager interruption, zero in the steady hot loop."""
+        if self.__dict__.get("_donation_ready", False):
+            return
+        st = self._state
+        for name, value in st.items():
+            st[name] = _fresh_state_value(value)
+
+    def _maybe_compiled_update(self, args: Tuple, kwargs: Dict[str, Any]) -> bool:
+        """Compiled fast path for one eager ``update`` call (called from the
+        ``_wrap_update`` shell with the bookkeeping already done)."""
+        disp = self._compiled_gate("update")
+        if disp is None:
+            return False
+        return self._compiled_dispatch("update", args, kwargs)[0]
+
+    def _maybe_compiled_forward(self, args: Tuple, kwargs: Dict[str, Any]):
+        """Compiled fast path for one eager ``forward``: update + batch-local
+        compute + merge in ONE program. Returns ``(handled, batch_value)``."""
+        disp = self._compiled_gate("forward")
+        if disp is None:
+            return False, None
+        # mirror the eager path: a stray forward on a grouped member
+        # copy-on-write detaches before anything shared could mutate, and
+        # forward's inner update marks the metric updated BEFORE the batch
+        # compute runs (the compute wrapper's not-yet-updated warning must
+        # not fire from the trace)
+        self._group_detach_if_stray()
+        self._update_called = True
+        handled, value = self._compiled_dispatch("forward", args, kwargs)
+        if not handled:
+            return False, None
+        self._update_count = getattr(self, "_update_count", 0) + 1
+        self._computed = None
+        self._forward_cache = value
+        ckpt = self.__dict__.get("_auto_checkpointer")
+        if ckpt is not None:
+            ckpt.after_update(self)
+        return True, value
 
     # ------------------------------------------------------------------
     # merge / reset / persistence
@@ -1100,6 +1444,10 @@ class Metric:
         }
 
     def _restore(self, state: Dict[str, Any]) -> None:
+        # restored leaves alias whatever `state` came from (a sync cache, a
+        # merged snapshot, defaults): the next compiled dispatch must copy
+        # before donating, or donation would invalidate the source's arrays
+        object.__setattr__(self, "_donation_ready", False)
         for k, v in state.items():
             self._state[k] = _copy_state_value(v)
 
@@ -1124,6 +1472,13 @@ class Metric:
         memo[id(self)] = new
         for k, v in self.__dict__.items():
             object.__setattr__(new, k, deepcopy(v, memo))
+        # deepcopy may hand immutable array leaves back by reference, so the
+        # clone and the original can share state buffers — neither may donate
+        # them until it has re-copied (the clone also starts with a fresh
+        # CompiledDispatcher via CompiledDispatcher.__deepcopy__: cached
+        # programs close over the original instance)
+        object.__setattr__(new, "_donation_ready", False)
+        object.__setattr__(self, "_donation_ready", False)
         return new
 
     # ------------------------------------------------------------------
@@ -1136,6 +1491,16 @@ class Metric:
 
     def state_dict(self, prefix: str = "") -> Dict[str, Any]:
         """Host-side snapshot of persistent states (numpy leaves)."""
+        # np.asarray of a CPU-backed jax array can be a zero-copy view; the
+        # snapshot must survive a later donating dispatch, so force a copy
+        # at the next compiled update instead of risking the view's buffer.
+        # In a compute group the snapshot views the SHARED arrays, so the
+        # latch must clear on every member — the leader is who dispatches.
+        group = self.__dict__.get("_compute_group")
+        if group is not None:
+            for m in group.members:
+                object.__setattr__(m, "_donation_ready", False)
+        object.__setattr__(self, "_donation_ready", False)
         out: Dict[str, Any] = {}
         for name in self._defaults:
             if not self._persistent[name]:
@@ -1180,6 +1545,8 @@ class Metric:
                     "Nothing was loaded."
                 )
         self._group_detach_if_stray()
+        # loaded leaves alias the caller's checkpoint arrays: copy-before-donate
+        object.__setattr__(self, "_donation_ready", False)
         for name in self._defaults:
             key = prefix + name
             if key in state_dict:
@@ -1350,6 +1717,7 @@ class Metric:
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
+        self.__dict__["_donation_ready"] = False
         self._state = apply_to_collection(self._state, (np.ndarray,), jnp.asarray)
         self._defaults = apply_to_collection(self._defaults, (np.ndarray,), jnp.asarray)
         self._cache = apply_to_collection(self._cache, (np.ndarray,), jnp.asarray)
@@ -1540,6 +1908,19 @@ def _wrap_update(update: Callable) -> Callable:
             # retraces are a compilation artifact, not data, and counting
             # them would skew the header across ranks that retrace unevenly
             self._update_count = getattr(self, "_update_count", 0) + 1
+            if self._maybe_compiled_update(args, kwargs):
+                # one donated-state XLA dispatch replaced the whole eager
+                # tail below (screening, dtype persistence and default
+                # materialization ran inside the traced program); only the
+                # host-side checkpoint hook remains
+                ckpt = self.__dict__.get("_auto_checkpointer")
+                if (
+                    ckpt is not None
+                    and not self.__dict__.get("_ckpt_suppress", False)
+                    and not self.__dict__.get("_pure_mode", False)
+                ):
+                    ckpt.after_update(self)
+                return None
         screening = getattr(self, "check_finite", False) and NONFINITE_STATE in self._state
         if screening:
             # pre-update list lengths: the post-update screen covers only the
@@ -1702,6 +2083,10 @@ class CompositionalMetric(Metric):
         >>> print(round(float(f1.compute()), 4))
         0.75
     """
+
+    #: operand updates run eagerly on the operand instances; compiling the
+    #: composite would trace through them and leak tracers into their state
+    compiled_update = False
 
     def __init__(
         self,

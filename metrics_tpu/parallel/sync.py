@@ -21,6 +21,18 @@ machinery (``metric.py:217-242``). Two paths:
   compute groups (``core/collections.py``) dedupe the combined payload one
   layer up: one gathered state per group of schema/update-identical members,
   so the bytes a grouped collection moves scale with its *unique* states.
+
+**Aliasing contract with the compiled eager hot path.** ``Metric.sync``
+hands this module the *pre-sync cache* (``Metric._cache``) — whose array
+leaves alias the live state — and restores either the gathered result or
+that cache later. Host gathers never mutate or consume their inputs (the
+collectives copy), and in the single-process short-circuit the "synced"
+leaves are returned by reference; both are safe because the compiled
+dispatch layer (``core/compiled.py``) donates a state buffer to XLA only
+after proving sole ownership: every restore (``Metric._restore``) clears
+the ``_donation_ready`` latch, so the first compiled update after a
+sync/unsync round re-copies its leaves instead of invalidating the cache
+or the just-restored snapshot in place.
 """
 from typing import Any, Callable, Dict, List, Optional, Union
 

@@ -20,6 +20,11 @@ The ``METRICS_TPU_COMPILE_CACHE`` env var switches the cache on without code
 changes (:func:`enable_from_env` — the dryrun driver and bench honor it):
 ``1``/``true``/``on`` uses the default dir, any other non-off value is taken
 as the cache directory, and ``0``/``false``/``off``/unset leaves it alone.
+
+The compiled eager hot path (``core/compiled.py``) calls
+:func:`enable_from_env` before building its first auto-JIT program, so a
+plain eager hot loop honors the env knob too — no entry-point code needed
+for its per-shape programs to persist across processes.
 """
 import os
 from typing import Optional

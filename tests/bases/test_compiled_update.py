@@ -1,0 +1,749 @@
+"""Compiled eager hot path: compiled ≡ eager bit-equality + fallback tests.
+
+The contract under test (``core/compiled.py`` + the wiring in
+``core/metric.py`` / ``core/collections.py``): routing the stateful
+``update()``/``forward()`` through a cached donated-state ``jax.jit``
+program changes NOTHING observable except speed — state leaves, computed
+values, update counts, poison flags and overflow latches are bit-identical
+to the per-op eager path; metrics the tracer cannot handle are detected at
+first trace and permanently routed to eager with a one-time diagnostic; and
+``METRICS_TPU_COMPILED_UPDATE=0`` / ``compiled_update=False`` restore the
+pure eager path exactly.
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    AveragePrecision,
+    F1,
+    MetricCollection,
+    Precision,
+    PrecisionRecallCurve,
+    Recall,
+    ROC,
+    Specificity,
+)
+from metrics_tpu.core.compiled import COMPILED_UPDATE_ENV, COMPILED_WARMUP_ENV
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.utils.data import dim_zero_cat
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+rng = np.random.RandomState(17)
+N_STEPS = 6
+BATCH = 64
+PREDS = [jnp.asarray(rng.rand(BATCH, 10).astype(np.float32)) for _ in range(N_STEPS)]
+TARGET = [jnp.asarray(rng.randint(0, 10, (BATCH,))) for _ in range(N_STEPS)]
+BPREDS = [jnp.asarray(rng.rand(BATCH).astype(np.float32)) for _ in range(N_STEPS)]
+BTARGET = [jnp.asarray(rng.randint(0, 2, (BATCH,))) for _ in range(N_STEPS)]
+
+
+def leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def assert_states_equal(eager, compiled, what=""):
+    assert sorted(eager._state) == sorted(compiled._state)
+    for name in eager._state:
+        assert leaves_equal(eager._state[name], compiled._state[name]), f"{what}: {name}"
+
+
+class SumMetric(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + jnp.asarray(x.shape[0], jnp.int32)
+
+    def compute(self):
+        return self.total / self.count
+
+
+class CatMetric(Metric):
+    """Cat-state metric — a CatBuffer (via with_capacity) compiles; the
+    plain growing-list mode is a declared static fallback."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("rows", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.rows.append(x)
+
+    def compute(self):
+        return jnp.sum(dim_zero_cat(self.rows))
+
+
+class LatchMetric(Metric):
+    """Undeclared instance-attribute latch: the probe must catch it."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.seen_items = None
+
+    def update(self, x):
+        if self.seen_items is None:
+            self.seen_items = int(np.prod(x.shape))
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+class BranchMetric(Metric):
+    """Data-dependent python control flow: untraceable (Concretization)."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("pos", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        if float(jnp.sum(x)) > 0:
+            self.pos = self.pos + jnp.sum(x)
+
+    def compute(self):
+        return self.pos
+
+
+def make_stat_collection(grouped=True):
+    return MetricCollection(
+        {
+            "prec": Precision(num_classes=10, average="macro"),
+            "rec": Recall(num_classes=10, average="macro"),
+            "f1": F1(num_classes=10, average="macro"),
+            "spec": Specificity(num_classes=10, average="macro"),
+        },
+        compute_groups=grouped,
+    )
+
+
+def set_compiled(obj, flag):
+    members = obj.values() if isinstance(obj, MetricCollection) else [obj]
+    for m in members:
+        m.compiled_update = flag
+    return obj
+
+
+def total_dispatches(mc):
+    cs = mc.compile_stats()
+    return cs["collection"]["dispatches"] + sum(s["dispatches"] for s in cs["members"].values())
+
+
+# ---------------------------------------------------------------------------
+# compiled ≡ eager equality matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("grouped", [True, False])
+def test_stat_collection_update_bit_identical(grouped):
+    eager = set_compiled(make_stat_collection(grouped), False)
+    compiled = set_compiled(make_stat_collection(grouped), True)
+    for i in range(N_STEPS):
+        eager.update(PREDS[i], TARGET[i])
+        compiled.update(PREDS[i], TARGET[i])
+    for (k, me), mc in zip(eager.items(), compiled.values()):
+        assert_states_equal(me, mc, k)
+        assert me._update_count == mc._update_count == N_STEPS
+        assert mc._update_called
+    ve, vc = eager.compute(), compiled.compute()
+    for k in ve:
+        assert leaves_equal(ve[k], vc[k]), k
+    assert total_dispatches(compiled) > 0
+    assert total_dispatches(eager) == 0
+
+
+@pytest.mark.parametrize("grouped", [True, False])
+def test_stat_collection_forward_bit_identical(grouped):
+    eager = set_compiled(make_stat_collection(grouped), False)
+    compiled = set_compiled(make_stat_collection(grouped), True)
+    for i in range(N_STEPS):
+        ve, vc = eager(PREDS[i], TARGET[i]), compiled(PREDS[i], TARGET[i])
+        for k in ve:
+            assert leaves_equal(ve[k], vc[k]), (i, k)
+    for (k, me), mc in zip(eager.items(), compiled.values()):
+        assert_states_equal(me, mc, k)
+    assert leaves_equal(list(eager.compute().values()), list(compiled.compute().values()))
+
+
+@pytest.mark.parametrize(
+    "make,batches",
+    [
+        (lambda: SumMetric(), [(p,) for p in BPREDS]),
+        (
+            lambda: Precision(num_classes=10, average="macro"),
+            list(zip(PREDS, TARGET)),
+        ),
+    ],
+    ids=["sum", "precision"],
+)
+def test_solo_metric_update_and_forward_identical(make, batches):
+    eager, compiled = set_compiled(make(), False), set_compiled(make(), True)
+    for batch in batches:
+        eager.update(*batch)
+        compiled.update(*batch)
+    assert_states_equal(eager, compiled)
+    assert leaves_equal(eager.compute(), compiled.compute())
+    eager, compiled = set_compiled(make(), False), set_compiled(make(), True)
+    for i, batch in enumerate(batches):
+        assert leaves_equal(eager(*batch), compiled(*batch)), i
+    assert_states_equal(eager, compiled)
+    assert compiled.compile_stats()["dispatches"] > 0
+
+
+def test_catbuffer_metric_compiles_bit_identical():
+    eager = set_compiled(CatMetric().with_capacity(BATCH * N_STEPS), False)
+    compiled = set_compiled(CatMetric().with_capacity(BATCH * N_STEPS), True)
+    for i in range(N_STEPS):
+        eager.update(BPREDS[i])
+        compiled.update(BPREDS[i])
+    assert_states_equal(eager, compiled)
+    assert leaves_equal(eager.compute(), compiled.compute())
+    stats = compiled.compile_stats()
+    assert stats["dispatches"] == N_STEPS and stats["fallback"] is None
+
+
+def test_catbuffer_metric_forward_bit_identical():
+    eager = set_compiled(CatMetric().with_capacity(BATCH * N_STEPS), False)
+    compiled = set_compiled(CatMetric().with_capacity(BATCH * N_STEPS), True)
+    for i in range(N_STEPS):
+        assert leaves_equal(eager(BPREDS[i]), compiled(BPREDS[i])), i
+    assert_states_equal(eager, compiled)
+
+
+def test_catbuffer_overflow_raises_on_compiled_path():
+    m = set_compiled(CatMetric().with_capacity(BATCH * 2), True)
+    m.update(BPREDS[0])
+    m.update(BPREDS[1])
+    with pytest.raises(MetricsTPUUserError, match="overflow"):
+        m.update(BPREDS[2])
+    # the latch stayed loud: the corrupted accumulation cannot be read
+    assert bool(np.asarray(m._state["rows"].overflowed))
+    with pytest.raises(MetricsTPUUserError):
+        m.compute()
+
+
+def test_growing_list_state_is_static_fallback():
+    m = set_compiled(CatMetric(), True)  # no with_capacity -> growing list
+    for i in range(3):
+        m.update(BPREDS[i])
+    stats = m.compile_stats()
+    assert stats["dispatches"] == 0
+    assert "list state" in stats["fallback"]["update"]
+    assert leaves_equal(m.compute(), jnp.sum(jnp.concatenate(BPREDS[:3])))
+
+
+def test_check_finite_poison_flag_identical_and_forward_falls_back():
+    bad = jnp.asarray(np.r_[np.full(8, np.inf), np.zeros(8)].astype(np.float32))
+    eager = set_compiled(SumMetric(check_finite=True), False)
+    compiled = set_compiled(SumMetric(check_finite=True), True)
+    for m in (eager, compiled):
+        m.update(BPREDS[0])
+        m.update(bad)
+    assert_states_equal(eager, compiled)
+    assert int(np.asarray(compiled._state["_nonfinite"])) == 1
+    assert compiled.compile_stats()["dispatches"] > 0
+    for m in (eager, compiled):
+        with pytest.raises(Exception, match="non-finite"):
+            m.compute()
+    # forward is a declared static fallback under check_finite (it must keep
+    # raising eagerly at the batch-compute step)
+    f = set_compiled(SumMetric(check_finite=True), True)
+    f(BPREDS[0])
+    assert "check_finite" in f.compile_stats()["fallback"]["forward"]
+
+
+def test_grouped_collection_with_midrun_detach_identical():
+    eager = set_compiled(make_stat_collection(True), False)
+    compiled = set_compiled(make_stat_collection(True), True)
+    for i in range(3):
+        eager.update(PREDS[i], TARGET[i])
+        compiled.update(PREDS[i], TARGET[i])
+    # out-of-group direct update on one member: copy-on-write detach on both
+    eager["rec"].update(PREDS[3], TARGET[3])
+    compiled["rec"].update(PREDS[3], TARGET[3])
+    assert compiled["rec"]._compute_group is None
+    for i in range(4, N_STEPS):
+        eager.update(PREDS[i], TARGET[i])
+        compiled.update(PREDS[i], TARGET[i])
+    for (k, me), mc in zip(eager.items(), compiled.values()):
+        assert_states_equal(me, mc, k)
+    assert leaves_equal(list(eager.compute().values()), list(compiled.compute().values()))
+
+
+def test_curve_family_falls_back_and_stays_identical():
+    def make():
+        return MetricCollection(
+            {
+                "roc": ROC().with_capacity(BATCH * N_STEPS),
+                "prc": PrecisionRecallCurve().with_capacity(BATCH * N_STEPS),
+                "ap": AveragePrecision().with_capacity(BATCH * N_STEPS),
+            }
+        )
+
+    eager, compiled = set_compiled(make(), False), set_compiled(make(), True)
+    for i in range(N_STEPS):
+        eager.update(BPREDS[i], BTARGET[i])
+        compiled.update(BPREDS[i], BTARGET[i])
+    for (k, me), mc in zip(eager.items(), compiled.values()):
+        assert_states_equal(me, mc, k)
+    assert total_dispatches(compiled) == 0
+    # the group dispatches through its leader, which records the reason
+    stats = compiled.compile_stats()["members"]
+    reasons = [s["fallback"]["update"] for s in stats.values() if s["fallback"]]
+    assert reasons and all("side-effect" in r for r in reasons)
+
+
+def test_accuracy_mode_latch_falls_back_identical():
+    eager, compiled = set_compiled(Accuracy(num_classes=10), False), set_compiled(
+        Accuracy(num_classes=10), True
+    )
+    for i in range(N_STEPS):
+        eager.update(PREDS[i], TARGET[i])
+        compiled.update(PREDS[i], TARGET[i])
+    assert_states_equal(eager, compiled)
+    assert leaves_equal(eager.compute(), compiled.compute())
+    stats = compiled.compile_stats()
+    assert stats["dispatches"] == 0 and "side-effect" in stats["fallback"]["update"]
+    assert compiled.mode == eager.mode  # the latch still latched, eagerly
+
+
+def test_mixed_collection_fallback_member_joins():
+    """A fallback-triggering member joining the collection shrinks the fused
+    program around it; results stay identical member for member."""
+
+    def make():
+        return MetricCollection(
+            {
+                "prec": Precision(num_classes=10, average="macro"),
+                "rec": Recall(num_classes=10, average="macro"),
+                "acc": Accuracy(num_classes=10),
+            },
+            compute_groups=False,
+        )
+
+    eager, compiled = set_compiled(make(), False), set_compiled(make(), True)
+    for i in range(N_STEPS):
+        eager.update(PREDS[i], TARGET[i])
+        compiled.update(PREDS[i], TARGET[i])
+    for (k, me), mc in zip(eager.items(), compiled.values()):
+        assert_states_equal(me, mc, k)
+    cs = compiled.compile_stats()
+    assert cs["members"]["acc"]["fallback"] is not None
+    assert cs["collection"]["dispatches"] == N_STEPS  # prec+rec fused, 1/step
+
+
+def test_ungrouped_collection_fuses_to_one_dispatch_per_step():
+    compiled = set_compiled(make_stat_collection(False), True)
+    for i in range(N_STEPS):
+        compiled.update(PREDS[i], TARGET[i])
+    cs = compiled.compile_stats()
+    assert cs["collection"]["dispatches"] == N_STEPS
+    assert all(s["dispatches"] == 0 for s in cs["members"].values())
+
+
+# ---------------------------------------------------------------------------
+# fallback behavior & knobs
+# ---------------------------------------------------------------------------
+
+
+def test_env_escape_hatch_restores_pure_eager(monkeypatch):
+    monkeypatch.setenv(COMPILED_UPDATE_ENV, "0")
+    m = set_compiled(SumMetric(), True)
+    for i in range(N_STEPS):
+        m.update(BPREDS[i])
+    stats = m.compile_stats()
+    assert stats["dispatches"] == 0 and stats["traces"] == 0
+    mc = set_compiled(make_stat_collection(False), True)
+    mc.update(PREDS[0], TARGET[0])
+    assert total_dispatches(mc) == 0
+
+
+def test_per_metric_knob_false_restores_pure_eager():
+    m = set_compiled(SumMetric(), False)
+    for i in range(N_STEPS):
+        m.update(BPREDS[i])
+    stats = m.compile_stats()
+    assert stats["dispatches"] == 0 and stats["traces"] == 0 and stats["steps_seen"] == 0
+
+
+def test_warmup_defers_first_trace(monkeypatch):
+    monkeypatch.setenv(COMPILED_WARMUP_ENV, "3")
+    m = SumMetric()  # compiled_update=None -> env warm-up applies
+    for i in range(3):
+        m.update(BPREDS[i % N_STEPS])
+    assert m.compile_stats()["traces"] == 0
+    m.update(BPREDS[3])
+    stats = m.compile_stats()
+    assert stats["traces"] == 1 and stats["dispatches"] == 1
+
+
+def test_untraceable_update_probe_fallback_one_time_diagnostic():
+    m = set_compiled(BranchMetric(), True)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for i in range(3):
+            m.update(BPREDS[i])
+    msgs = [str(w.message) for w in caught if "compiled eager" in str(w.message)]
+    assert len(msgs) == 1 and "not traceable" in msgs[0]
+    stats = m.compile_stats()
+    assert stats["dispatches"] == 0 and "not traceable" in stats["fallback"]["update"]
+    # the eager path kept working, with correct values
+    expected = sum(float(np.sum(np.asarray(p))) for p in BPREDS[:3])
+    np.testing.assert_allclose(float(m.compute()), expected, rtol=1e-5)
+
+
+def test_undeclared_side_effect_latch_probe_fallback():
+    eager, compiled = LatchMetric(), set_compiled(LatchMetric(), True)
+    for i in range(3):
+        eager.update(BPREDS[i])
+        compiled.update(BPREDS[i])
+    stats = compiled.compile_stats()
+    assert "side-effect latch" in stats["fallback"]["update"]
+    # the probe restored the attr before the eager run re-derived it
+    assert compiled.seen_items == eager.seen_items == BATCH
+    assert_states_equal(eager, compiled)
+
+
+def test_shape_churn_warns_once(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_COMPILED_TRACE_WARN", "3")
+    m = set_compiled(SumMetric(), True)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for n in range(1, 8):  # a new shape every step: worst-case churn
+            m.update(jnp.asarray(np.ones(n, np.float32)))
+    msgs = [str(w.message) for w in caught if "retraced" in str(w.message)]
+    assert len(msgs) == 1
+    stats = m.compile_stats()
+    assert stats["traces"] >= 3
+    np.testing.assert_allclose(float(m.compute()), 1.0)
+
+
+def test_recompile_storm_falls_back_permanently(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_COMPILED_TRACE_WARN", "2")  # storm at 8
+    m = set_compiled(SumMetric(), True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for n in range(1, 12):  # a new shape every step
+            m.update(jnp.asarray(np.ones(n, np.float32)))
+    stats = m.compile_stats()
+    assert "recompile storm" in stats["fallback"]["update"]
+    assert stats["traces"] == 8  # compiling stopped at the storm threshold
+    np.testing.assert_allclose(float(m.compute()), 1.0)
+
+
+def test_per_batch_static_scalar_storms_to_eager(monkeypatch):
+    """A python scalar that changes every batch is a new static key per
+    step — probe + compile each time; the storm fallback must disengage."""
+    monkeypatch.setenv("METRICS_TPU_COMPILED_TRACE_WARN", "2")
+
+    class WeightedSum(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x, weight):
+            self.total = self.total + weight * jnp.sum(x)
+
+        def compute(self):
+            return self.total
+
+    eager, compiled = WeightedSum(), set_compiled(WeightedSum(), True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(12):
+            w = 0.1 * (i + 1)  # fresh float every step
+            eager.update(BPREDS[i % N_STEPS], w)
+            compiled.update(BPREDS[i % N_STEPS], w)
+    assert "recompile storm" in compiled.compile_stats()["fallback"]["update"]
+    assert_states_equal(eager, compiled)
+
+
+def test_inplace_container_latch_probe_fallback():
+    """An in-place container mutation (append) in update is a side-effect
+    latch just like an attribute assignment: the probe must catch it."""
+
+    class AppendingMetric(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+            self.batch_sizes = []
+
+        def update(self, x):
+            self.batch_sizes.append(int(x.shape[0]))
+            self.total = self.total + jnp.sum(x)
+
+        def compute(self):
+            return self.total / len(self.batch_sizes)
+
+    eager, compiled = AppendingMetric(), set_compiled(AppendingMetric(), True)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(3):
+            eager.update(BPREDS[i])
+            compiled.update(BPREDS[i])
+    stats = compiled.compile_stats()
+    assert stats["dispatches"] == 0 and "side-effect latch" in stats["fallback"]["update"]
+    # the probe restored the list, and the eager path kept appending
+    assert compiled.batch_sizes == eager.batch_sizes == [BATCH] * 3
+    assert leaves_equal(eager.compute(), compiled.compute())
+
+
+def test_global_warning_filters_untouched():
+    import metrics_tpu.core.compiled  # noqa: F401 - the import under test
+
+    assert not any(
+        f[1] is not None and "donated" in (f[1].pattern if hasattr(f[1], "pattern") else "")
+        for f in warnings.filters
+    ), "importing the compiled layer must not mutate the global warning filters"
+
+
+def test_ragged_tail_retraces_once_then_caches():
+    m = set_compiled(SumMetric(), True)
+    full, tail = BPREDS[0], BPREDS[1][: BATCH // 2]
+    for _ in range(3):  # three "epochs" with a ragged tail
+        m.update(full)
+        m.update(tail)
+    stats = m.compile_stats()
+    assert stats["traces"] == 2 and stats["dispatches"] == 6
+
+
+# ---------------------------------------------------------------------------
+# donation safety & interop
+# ---------------------------------------------------------------------------
+
+
+def test_donation_never_invalidates_defaults_or_reset():
+    m = set_compiled(SumMetric(), True)
+    for i in range(N_STEPS):
+        m.update(BPREDS[i])
+    m.reset()
+    assert float(np.asarray(m._state["total"])) == 0.0
+    m.update(BPREDS[0])
+    np.testing.assert_allclose(
+        float(np.asarray(m._state["total"])), float(np.sum(np.asarray(BPREDS[0]))), rtol=1e-6
+    )
+
+
+def test_donation_never_invalidates_user_held_reference():
+    m = set_compiled(SumMetric(), True)
+    m.update(BPREDS[0])
+    held = m.total  # reading the attr hands out the live buffer
+    before = float(np.asarray(held))
+    for i in range(1, 4):
+        m.update(BPREDS[i])
+    # the held array must still be readable (the read cleared the donation
+    # latch, so the next dispatch copied instead of consuming the buffer)
+    assert float(np.asarray(held)) == before
+
+
+def test_donation_never_invalidates_clone():
+    m = set_compiled(SumMetric(), True)
+    m.update(BPREDS[0])
+    c = m.clone()
+    snap = float(np.asarray(c._state["total"]))
+    for i in range(1, 4):
+        m.update(BPREDS[i])
+    assert float(np.asarray(c._state["total"])) == snap
+    # the clone's own compiled path still works independently
+    c.update(BPREDS[1])
+    assert c.compile_stats()["dispatches"] >= 0
+
+
+def test_two_instances_share_no_buffers():
+    # jnp's constant cache can alias both metrics' zero-initialized states;
+    # copy-on-first-donation must decouple them
+    a, b = set_compiled(SumMetric(), True), set_compiled(SumMetric(), True)
+    a.update(BPREDS[0])
+    total_b = float(np.asarray(b._state["total"]))
+    assert total_b == 0.0
+
+
+def test_sync_unsync_roundtrip_with_compiled_updates():
+    def fake_sync(state, reductions):
+        # a world of 2 identical ranks: every reduce leaf doubles
+        return {k: v * 2 if not isinstance(v, list) else v for k, v in state.items()}
+
+    m = set_compiled(SumMetric(), True)
+    m.dist_sync_fn = fake_sync
+    m.distributed_available_fn = lambda: True
+    for i in range(3):
+        m.update(BPREDS[i])
+    local = {k: np.asarray(v) for k, v in m._state.items()}
+    m.sync()
+    assert np.array_equal(np.asarray(m._state["total"]), local["total"] * 2)
+    m.unsync()
+    # the pre-sync cache survived (donation did not invalidate it) and the
+    # compiled path keeps accumulating on the restored state
+    for k in local:
+        assert np.array_equal(np.asarray(m._state[k]), local[k]), k
+    m.update(BPREDS[3])
+    expected = local["total"] + np.asarray(jnp.sum(BPREDS[3]))
+    np.testing.assert_allclose(np.asarray(m._state["total"]), expected, rtol=1e-6)
+
+
+def test_state_dict_snapshot_survives_later_compiled_updates():
+    m = set_compiled(SumMetric(), True)
+    m.persistent(True)
+    m.update(BPREDS[0])
+    snap = m.state_dict()
+    frozen = {k: np.array(v, copy=True) for k, v in snap.items()}
+    for i in range(1, 4):
+        m.update(BPREDS[i])
+    for k in snap:
+        assert np.array_equal(np.asarray(snap[k]), frozen[k]), k
+
+
+def test_compiled_then_eager_interleave_identical():
+    eager, mixed = set_compiled(SumMetric(), False), set_compiled(SumMetric(), True)
+    for i in range(3):
+        eager.update(BPREDS[i])
+        mixed.update(BPREDS[i])
+    mixed.compiled_update = False  # flip mid-run: back to pure eager
+    for i in range(3, N_STEPS):
+        eager.update(BPREDS[i])
+        mixed.update(BPREDS[i])
+    assert_states_equal(eager, mixed)
+    assert leaves_equal(eager.compute(), mixed.compute())
+
+
+def test_checkpointer_hook_fires_on_compiled_updates(tmp_path):
+    m = set_compiled(SumMetric(), True)
+    m2 = SumMetric()
+    with m.checkpointer(str(tmp_path), every_n_updates=2):
+        for i in range(4):
+            m.update(BPREDS[i])
+    from metrics_tpu.core.checkpoint import load_checkpoint
+
+    load_checkpoint(m2, str(tmp_path))
+    assert_states_equal(m, m2)
+    assert m.compile_stats()["dispatches"] > 0
+
+
+def test_pickle_roundtrip_drops_programs_keeps_state():
+    import pickle
+
+    m = set_compiled(SumMetric(), True)
+    for i in range(3):
+        m.update(BPREDS[i])
+    m2 = pickle.loads(pickle.dumps(m))
+    assert_states_equal(m, m2)
+    stats = m2.compile_stats()
+    assert stats["dispatches"] == 0  # fresh dispatcher; programs never pickle
+    m2.update(BPREDS[3])  # and the compiled path re-engages cleanly
+    assert m2.compile_stats()["dispatches"] == 1
+
+
+def test_eager_pure_update_stays_pure_alongside_compiled_path():
+    """An EAGER pure_update on a compiled-engaged metric must never donate
+    the caller's state, corrupt the instance accumulation, or leave a stale
+    donation latch over aliased defaults."""
+    m = set_compiled(SumMetric(), True)
+    m.update(BPREDS[0])
+    m.update(BPREDS[1])  # latch armed: state = last dispatch's outputs
+    inst_total = float(np.asarray(m._state["total"]))
+    caller_state = m.init_state()
+    out = m.pure_update(caller_state, BPREDS[2])
+    # the caller's input state survived (no donation) and is still readable
+    assert float(np.asarray(caller_state["total"])) == 0.0
+    np.testing.assert_allclose(
+        float(np.asarray(out["total"])), float(np.sum(np.asarray(BPREDS[2]))), rtol=1e-6
+    )
+    # the instance accumulation was untouched by the pure call
+    assert float(np.asarray(m._state["total"])) == inst_total
+    # the stateful compiled path keeps working and stays correct after
+    m.update(BPREDS[3])
+    expected = sum(float(np.sum(np.asarray(BPREDS[i]))) for i in (0, 1, 3))
+    np.testing.assert_allclose(float(np.asarray(m._state["total"])), expected, rtol=1e-5)
+    # a metric whose FIRST call is a pure_update must not poison its
+    # defaults either (fresh instance, immediate pure call, then reset)
+    m2 = set_compiled(SumMetric(), True)
+    m2.pure_update(m2.init_state(), BPREDS[0])
+    m2.update(BPREDS[1])
+    m2.reset()
+    assert float(np.asarray(m2._state["total"])) == 0.0
+
+
+def test_state_dict_on_group_sibling_disarms_leader_donation():
+    mc = set_compiled(make_stat_collection(True), True)
+    for m in mc.values():
+        m.persistent(True)
+    for i in range(3):
+        mc.update(PREDS[i], TARGET[i])
+    leader = next(iter(mc.values()))._compute_group.members[0]
+    assert leader.__dict__.get("_donation_ready", False)
+    sibling = [m for m in mc.values() if m is not leader][0]
+    snap = sibling.state_dict()
+    frozen = {k: np.array(v, copy=True) for k, v in snap.items()}
+    # the sibling's snapshot views the SHARED arrays: the leader must have
+    # been disarmed too, so the next dispatch copies instead of donating
+    assert not leader.__dict__.get("_donation_ready", False)
+    mc.update(PREDS[3], TARGET[3])
+    for k in snap:
+        assert np.array_equal(np.asarray(snap[k]), frozen[k]), k
+
+
+class BranchPairMetric(Metric):
+    """Collection-compatible (preds, target) metric whose update branches on
+    a concrete value — untraceable, but with no statically-declared marker,
+    so only the first-trace probe can discover it."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("pos", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, preds, target):
+        if float(jnp.sum(target)) >= 0:
+            self.pos = self.pos + jnp.sum(preds)
+
+    def compute(self):
+        return self.pos
+
+
+def test_probe_failing_member_shrinks_fused_program():
+    """A probe-detected (not statically-declared) untraceable member must
+    only exclude itself: the remaining members re-fuse on the next step."""
+
+    def make():
+        return MetricCollection(
+            {
+                "prec": Precision(num_classes=10, average="macro"),
+                "rec": Recall(num_classes=10, average="macro"),
+                "branch": BranchPairMetric(),
+            },
+            compute_groups=False,
+        )
+
+    mc, ref = set_compiled(make(), True), set_compiled(make(), False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for i in range(N_STEPS):
+            mc.update(PREDS[i], TARGET[i])
+            ref.update(PREDS[i], TARGET[i])
+    cs = mc.compile_stats()
+    assert cs["members"]["branch"]["fallback"], "culprit must be attributed"
+    assert cs["collection"]["fallback"] is None, "collection must not give up"
+    assert cs["collection"]["dispatches"] == N_STEPS - 1, "remaining members must re-fuse"
+    for (k, me), mm in zip(ref.items(), mc.values()):
+        assert_states_equal(me, mm, k)
+
+
+def test_compiled_forward_memoization_parity():
+    eager, compiled = set_compiled(SumMetric(), False), set_compiled(SumMetric(), True)
+    for i in range(3):
+        ve, vc = eager(BPREDS[i]), compiled(BPREDS[i])
+        assert leaves_equal(ve, vc)
+        assert leaves_equal(eager._forward_cache, compiled._forward_cache)
+    assert leaves_equal(eager.compute(), compiled.compute())
+    # memoized compute after forward behaves the same
+    assert leaves_equal(eager.compute(), compiled.compute())
