@@ -1,4 +1,4 @@
-.PHONY: test test-par test-fast test-ci test-nightly doctest docs bench perf-smoke verify-pretrained clean
+.PHONY: test test-par test-fast test-ci test-nightly doctest docs bench perf-smoke verify-pretrained lint-metrics clean
 
 # Dev workflow targets (analogue of the reference's Makefile:1-28, minus the
 # network-dependent env/pip steps — this image is zero-egress).
@@ -43,6 +43,22 @@ doctest:
 # regenerate the per-metric API pages (gated by tests/utils/test_docs_reference.py)
 docs:
 	python docs/generate_reference.py
+
+# metricslint static contract gate (docs/static_analysis.md): the shipped
+# package must lint clean, and every violation fixture must still FAIL —
+# a linter that stops finding the planted violations is a broken gate.
+# Exit codes are discriminated: only 1 (findings) counts as "fails as
+# intended"; 2 (missing path) or an empty glob means the gate itself broke.
+lint-metrics:
+	python -m metrics_tpu.analysis metrics_tpu/
+	@set -e; found=0; for f in tests/analysis/fixtures/violating_*.py; do \
+		[ -e "$$f" ] || { echo "lint-metrics: no violation fixtures matched — gate is vacuous"; exit 1; }; \
+		found=1; \
+		rc=0; python -m metrics_tpu.analysis -q "$$f" >/dev/null 2>&1 || rc=$$?; \
+		if [ $$rc -eq 1 ]; then echo "lint-metrics: $$f fails as intended"; \
+		elif [ $$rc -eq 0 ]; then echo "lint-metrics: $$f unexpectedly clean — rule regression"; exit 1; \
+		else echo "lint-metrics: $$f exited $$rc (expected 1) — gate broken"; exit 1; fi; \
+	done; [ $$found -eq 1 ]
 
 # benchmark contract line (TPU when the tunnel is alive, CPU fallback otherwise);
 # `--all` additionally runs configs 2-8 (8 = host-sync collective-fusion counts)

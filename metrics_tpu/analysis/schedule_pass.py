@@ -1,0 +1,400 @@
+"""metricslint collective-schedule pass — rank/data-independent emission order.
+
+The whole fault-tolerance stack (``parallel/health.py``'s one-header
+protocol, the bucketed payload planner, compute-group dedup) rests on ONE
+invariant: **every rank emits the same collectives in the same order**, no
+matter what its local data looks like. A collective emitted under a branch
+that only some ranks take pairs with the wrong peer collective and returns
+garbage without erroring — the failure mode the channel-suspect latch
+exists to paper over, and the property statically-planned redistribution
+schedules simply assume (PAPERS.md: "Memory-efficient array redistribution
+through portable collective communication"). This pass checks the invariant
+at lint time instead of discovering it as a cross-rank hang.
+
+Model (documented in ``docs/static_analysis.md``; deliberately simple
+enough to be sound *for this codebase's conventions* rather than for
+arbitrary Python):
+
+- **Collective primitives**: ``process_allgather`` (raw/watchdog-wrapped),
+  ``lax.psum/pmean/pmax/pmin/all_gather``. A function that (transitively,
+  within its module) calls one of these is *collective-emitting*; calling
+  it counts as emitting.
+- **Symmetric values** (safe to branch on): literal/config values, world
+  size (``jax.process_count``), env knobs, schema (``.shape``/``.dtype``/
+  ``.ndim``/``.size`` — the sync-header protocol verifies schema equality
+  before any payload), function parameters (the caller owns their
+  symmetry; parameters that by convention carry per-rank data are the
+  exception below), and — crucially — **the result of any collective**:
+  a gather returns the same world-stacked value on every rank, so
+  branching on it is symmetric by construction.
+- **Asymmetric (local) values**: ``jax.process_index()`` (rank taint),
+  per-rank data parameters (``state``/``value``/``values``/``result``/
+  ``x``/``word``/``update_count`` — the naming convention of
+  ``parallel/{sync,health,bucketing}.py``), ``len()`` of local data,
+  ``channel_is_suspect()`` (a per-process latch), and anything derived
+  from these by assignment.
+
+Findings: a collective (or collective-emitting call) governed by a
+rank-tainted guard (``rank-dependent-collective``), by a local-data guard
+(``data-dependent-collective``), emitted from an ``except``/``finally``
+block (``collective-in-handler``), or emitted while iterating an unordered
+``set`` (``nondeterministic-collective-order``). Early ``raise``/``return``
+under a local guard counts as governing every later collective in the
+function — skipping is as asymmetric as emitting.
+"""
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from metrics_tpu.analysis.report import Finding
+
+#: call names that ARE a cross-rank collective
+COLLECTIVE_CALLS = frozenset(
+    {
+        "process_allgather",
+        "_process_allgather",
+        "_raw_process_allgather",
+        "psum",
+        "pmean",
+        "pmax",
+        "pmin",
+        "all_gather",
+    }
+)
+
+#: parameter names that carry per-rank data by module convention
+LOCAL_DATA_PARAMS = frozenset(
+    {"state", "value", "values", "result", "x", "word", "update_count", "local_value"}
+)
+
+#: calls whose results are per-rank local no matter the arguments
+_LOCAL_CALLS = frozenset({"channel_is_suspect", "process_index", "build_health_word"})
+
+#: calls whose results are symmetric no matter the arguments (collective
+#: results are world-replicated; verify_health_words raises symmetrically
+#: from symmetric input and returns nothing asymmetric)
+_SYMMETRIC_CALLS = COLLECTIVE_CALLS | frozenset(
+    {
+        "verify_health_words",
+        "header_cat_lengths",
+        "gather_all_arrays",
+        "process_count",
+        "jit_distributed_available",
+        "fused_sync_enabled",
+        "get_sync_timeout",
+        # type/shape predicates are schema, which the header verifies equal
+        "isinstance",
+        "callable",
+        # the sync plan is a pure function of the (header-verified) schema
+        "build_sync_plan",
+    }
+)
+
+#: attribute reads that are schema, not data (header-verified cross-rank)
+_SCHEMA_ATTRS = frozenset({"shape", "dtype", "ndim", "size", "capacity", "item_size", "item_shape", "kind", "fx", "name", "cat_index"})
+
+
+@dataclass
+class _FnInfo:
+    name: str
+    node: ast.FunctionDef
+    emits_direct: bool = False
+    calls: Set[str] = field(default_factory=set)
+    emits: bool = False  # transitive, filled by fixpoint
+
+
+def _call_name(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _module_functions(tree: ast.Module) -> Dict[str, _FnInfo]:
+    """Top-level (and class-nested) function table with direct-emission and
+    local-call-graph facts."""
+    out: Dict[str, _FnInfo] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        info = _FnInfo(node.name, node)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _call_name(sub.func)
+                if name in COLLECTIVE_CALLS:
+                    info.emits_direct = True
+                elif name:
+                    info.calls.add(name)
+        out.setdefault(node.name, info)
+    # transitive emission fixpoint over the intra-module call graph
+    changed = True
+    for info in out.values():
+        info.emits = info.emits_direct
+    while changed:
+        changed = False
+        for info in out.values():
+            if info.emits:
+                continue
+            if any(c in out and out[c].emits for c in info.calls):
+                info.emits = True
+                changed = True
+    return out
+
+
+class _GuardTaint:
+    """Per-function taint classification of expressions: 'rank', 'local' or
+    None (symmetric). Forward propagation through assignments, with
+    collective results washing taint (their output is world-replicated)."""
+
+    def __init__(self, fn: ast.FunctionDef) -> None:
+        self.local_names: Set[str] = set()
+        self.rank_names: Set[str] = set()
+        args = fn.args
+        for a in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            if a.arg in LOCAL_DATA_PARAMS:
+                self.local_names.add(a.arg)
+        self._propagate(fn)
+
+    def _propagate(self, fn: ast.FunctionDef) -> None:
+        for _ in range(3):
+            changed = False
+            for node in ast.walk(fn):
+                targets: List[ast.expr] = []
+                value: Optional[ast.expr] = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.For):
+                    # dict.items() over local data: the KEY is schema (the
+                    # header verifies the key set), only the VALUE is local
+                    if (
+                        isinstance(node.iter, ast.Call)
+                        and _call_name(node.iter.func) == "items"
+                        and isinstance(node.target, ast.Tuple)
+                        and len(node.target.elts) == 2
+                    ):
+                        targets, value = [node.target.elts[1]], node.iter
+                    else:
+                        targets, value = [node.target], node.iter
+                if value is None:
+                    continue
+                taint = self.classify(value)
+                if taint is None:
+                    continue
+                bucket = self.rank_names if taint == "rank" else self.local_names
+                for t in targets:
+                    for n in self._target_names(t):
+                        if n not in bucket:
+                            bucket.add(n)
+                            changed = True
+            if not changed:
+                break
+
+    @staticmethod
+    def _target_names(t: ast.expr) -> List[str]:
+        if isinstance(t, ast.Name):
+            return [t.id]
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for el in t.elts:
+                out.extend(_GuardTaint._target_names(el))
+            return out
+        if isinstance(t, ast.Starred):
+            return _GuardTaint._target_names(t.value)
+        return []
+
+    def classify(self, expr: ast.expr, iteration: bool = False) -> Optional[str]:
+        """Worst taint anywhere in ``expr``: 'rank' > 'local' > None.
+        Symmetric-call results stop the descent (washing their arguments).
+
+        ``iteration=True`` classifies a ``for`` iterable for *loop shape*
+        (count/order of iterations) rather than element values: iterating
+        ``state.items()``/``.keys()`` is schema-ordered (the key set and
+        insertion order are part of the verified schema) even though the
+        yielded *values* are per-rank data — the element taint still flows
+        to the loop targets via ``_propagate``'s full descent.
+        """
+        worst: Optional[str] = None
+
+        def visit(node: ast.AST) -> None:
+            nonlocal worst
+            if worst == "rank":
+                return
+            if isinstance(node, ast.Call):
+                name = _call_name(node.func)
+                if name == "process_index":
+                    worst = "rank"
+                    return
+                if name in _LOCAL_CALLS:
+                    worst = worst or "local"
+                    # arguments cannot raise severity past 'local' except rank
+                if name in _SYMMETRIC_CALLS:
+                    return  # result is world-replicated; do not descend
+                if iteration and name in ("items", "keys"):
+                    return  # dict iteration order is schema, not data
+                if name == "len":
+                    # len() of local data is local; of symmetric data symmetric
+                    for arg in node.args:
+                        visit(arg)
+                    return
+            if isinstance(node, ast.Attribute):
+                if node.attr in _SCHEMA_ATTRS:
+                    return  # schema read — header-verified symmetric
+            if isinstance(node, ast.Name):
+                if node.id in self.rank_names:
+                    worst = "rank"
+                elif node.id in self.local_names:
+                    worst = worst or "local"
+                return
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(expr)
+        return worst
+
+
+@dataclass
+class _Ctx:
+    guards: Tuple[Tuple[str, int], ...] = ()  # (taint, guard line)
+    handler: Optional[int] = None             # line of enclosing except/finally
+    set_loop: Optional[int] = None            # line of enclosing for-over-set
+
+
+def _is_set_iterable(expr: ast.expr) -> bool:
+    if isinstance(expr, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(expr, ast.Call) and _call_name(expr.func) == "set":
+        return True
+    return False
+
+
+def check_function(
+    fns: Dict[str, _FnInfo], info: _FnInfo, path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    taint = _GuardTaint(info.node)
+    #: taint of early-exit guards seen so far, in source order: a local raise
+    #: /return before a collective conditions every later collective
+    early_exits: List[Tuple[str, int]] = []
+
+    def emits(node: ast.Call) -> bool:
+        name = _call_name(node.func)
+        if name in COLLECTIVE_CALLS:
+            return True
+        return name in fns and fns[name].emits and name != info.name
+
+    def has_early_exit(body: Sequence[ast.stmt]) -> bool:
+        for stmt in body:
+            if isinstance(stmt, (ast.Raise, ast.Return, ast.Continue, ast.Break)):
+                return True
+        return False
+
+    def walk(stmts: Sequence[ast.stmt], ctx: _Ctx) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                t = taint.classify(stmt.test)
+                inner = ctx
+                if t is not None:
+                    inner = _Ctx(ctx.guards + ((t, stmt.lineno),), ctx.handler, ctx.set_loop)
+                    if has_early_exit(stmt.body) or has_early_exit(stmt.orelse):
+                        early_exits.append((t, stmt.lineno))
+                walk(stmt.body, inner)
+                walk(stmt.orelse, inner)
+            elif isinstance(stmt, ast.While):
+                t = taint.classify(stmt.test)
+                inner = _Ctx(ctx.guards + (((t, stmt.lineno),) if t else ()), ctx.handler, ctx.set_loop)
+                walk(stmt.body, inner)
+                walk(stmt.orelse, inner)
+            elif isinstance(stmt, ast.For):
+                t = taint.classify(stmt.iter, iteration=True)
+                set_loop = stmt.lineno if _is_set_iterable(stmt.iter) else ctx.set_loop
+                inner = _Ctx(ctx.guards + (((t, stmt.lineno),) if t else ()), ctx.handler, set_loop)
+                walk(stmt.body, inner)
+                walk(stmt.orelse, inner)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body, ctx)
+                for handler in stmt.handlers:
+                    walk(handler.body, _Ctx(ctx.guards, handler.lineno, ctx.set_loop))
+                walk(stmt.orelse, ctx)
+                if stmt.finalbody:
+                    walk(stmt.finalbody, _Ctx(ctx.guards, stmt.finalbody[0].lineno, ctx.set_loop))
+            elif isinstance(stmt, ast.With):
+                walk(stmt.body, ctx)
+            elif isinstance(stmt, ast.FunctionDef):
+                continue  # nested defs analyzed via their own _FnInfo
+            else:
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call) and emits(node):
+                        report(node, ctx, stmt)
+                    elif isinstance(node, ast.IfExp) and taint.classify(node.test) is not None:
+                        t = taint.classify(node.test)
+                        for sub in ast.walk(node):
+                            if isinstance(sub, ast.Call) and emits(sub):
+                                report(sub, _Ctx(ctx.guards + ((t, node.lineno),), ctx.handler, ctx.set_loop), stmt)
+
+    def report(node: ast.Call, ctx: _Ctx, stmt: ast.stmt) -> None:
+        name = _call_name(node.func) or "<collective>"
+        what = (
+            f"collective {name}()"
+            if name in COLLECTIVE_CALLS
+            else f"call to collective-emitting {name}()"
+        )
+        governing = list(ctx.guards) + early_exits
+        for t, line in governing:
+            rule = "rank-dependent-collective" if t == "rank" else "data-dependent-collective"
+            findings.append(
+                Finding(
+                    rule, path, node.lineno, node.col_offset,
+                    f"{info.name}: {what} is governed by a "
+                    f"{'rank' if t == 'rank' else 'per-rank data'}-dependent branch "
+                    f"(line {line}) — ranks taking different sides emit different "
+                    "collective schedules and the gathers pair wrong",
+                    owner=info.name,
+                )
+            )
+        if ctx.handler is not None:
+            findings.append(
+                Finding(
+                    "collective-in-handler", path, node.lineno, node.col_offset,
+                    f"{info.name}: {what} inside an except/finally block (line "
+                    f"{ctx.handler}) — only provably symmetric failures may be "
+                    "followed by more collectives",
+                    owner=info.name,
+                )
+            )
+        if ctx.set_loop is not None:
+            findings.append(
+                Finding(
+                    "nondeterministic-collective-order", path, node.lineno, node.col_offset,
+                    f"{info.name}: {what} inside iteration over an unordered set "
+                    f"(line {ctx.set_loop}) — emission order must be deterministic "
+                    "and identical on every rank",
+                    owner=info.name,
+                )
+            )
+
+    walk(info.node.body, _Ctx())
+    # deduplicate (the same call can be reported once per governing guard —
+    # keep that — but identical (rule, line, col, message) entries collapse)
+    seen: Set[Tuple] = set()
+    unique: List[Finding] = []
+    for f in findings:
+        key = (f.rule, f.line, f.col, f.message)
+        if key not in seen:
+            seen.add(key)
+            unique.append(f)
+    return unique
+
+
+def run_schedule_pass(tree: ast.Module, path: str) -> List[Finding]:
+    fns = _module_functions(tree)
+    findings: List[Finding] = []
+    for info in fns.values():
+        if not (info.emits_direct or any(c in fns and fns[c].emits for c in info.calls)):
+            continue
+        findings.extend(check_function(fns, info, path))
+    return findings

@@ -31,6 +31,7 @@ from metrics_tpu.core.compiled import (
     CompiledDispatcher,
     compiled_update_enabled,
     compiled_warmup,
+    consult_static,
     dispatch_program,
     probe_traceable,
     rebuild_call,
@@ -46,6 +47,27 @@ from metrics_tpu.core.metric import (
 from metrics_tpu.parallel.health import FUSED_KEY_SEP as _FUSED_KEY_SEP
 from metrics_tpu.utils.data import is_traced
 from metrics_tpu.utils.exceptions import MetricsTPUUserError, SyncError
+from metrics_tpu.utils.prints import rank_zero_warn
+
+
+#: classes already warned about a statically-detected grouping hazard (the
+#: declared update_identity promises a side-effect-free update, but the
+#: metricslint report shows an undeclared latch) — warn once per class
+_static_hazard_warned: set = set()
+
+
+def _static_grouping_hazards(m: "Metric") -> List[str]:
+    """metricslint validation of a compute-group candidate: reasons the
+    class's update provably breaks the grouping contract (writes an attr
+    that is neither an ``add_state`` state nor a declared
+    ``_group_shared_attrs`` latch). Empty when clean, unresolvable, or
+    pre-classification is disabled (``METRICS_TPU_ANALYSIS_PRECLASSIFY=0``).
+    Deterministic from source, so every rank plans the same partition."""
+    try:
+        from metrics_tpu.analysis.runtime import grouping_hazards
+    except Exception:  # pragma: no cover - analysis package always ships
+        return []
+    return grouping_hazards(m)
 
 #: Env escape hatch: set to 0/false/off to disable compute-group formation
 #: (every member then updates and owns state independently, as before).
@@ -347,6 +369,22 @@ class MetricCollection(dict):
             ident = m._effective_update_identity()
             if ident is None:
                 continue
+            hazards = _static_grouping_hazards(m)
+            if hazards:
+                # the class declares an update_identity but its update
+                # provably latches an undeclared attribute: grouping would
+                # leave siblings with stale latches. Keep it solo (results
+                # stay correct, the dedup is lost) and say why, once.
+                if type(m) not in _static_hazard_warned:
+                    _static_hazard_warned.add(type(m))
+                    rank_zero_warn(
+                        f"{type(m).__name__} declares update_identity() but is "
+                        "excluded from compute groups: " + "; ".join(hazards[:3])
+                        + ". Declare the attribute(s) in _group_shared_attrs "
+                        "(or with add_state) to restore grouping.",
+                        UserWarning,
+                    )
+                continue
             key = (ident, m.state_fingerprint()) + self._sync_config_key(m)
             if key not in buckets:
                 order.append(key)
@@ -419,6 +457,20 @@ class MetricCollection(dict):
                     "registered under several keys updates once per key and cannot "
                     "join a compute group."
                 )
+            for k, m in zip(keys, ms):
+                hazards = _static_grouping_hazards(m)
+                if hazards:
+                    # an explicit override is the user's promise, but the
+                    # static report *refutes* it with a concrete attr+line:
+                    # shared dispatch would silently skip that latch on
+                    # every non-dispatching sibling — refuse loudly.
+                    raise MetricsTPUUserError(
+                        f"compute_groups override groups {keys}, but metricslint "
+                        f"statically refutes {k!r} ({type(m).__name__}) as a group "
+                        "member: " + "; ".join(hazards[:3]) + ". Declare the "
+                        "attribute(s) in _group_shared_attrs (or with add_state), "
+                        "or remove the metric from the explicit group."
+                    )
             fp0 = ms[0].state_fingerprint()
             cfg0 = self._sync_config_key(ms[0])
             for k, m in zip(keys[1:], ms[1:]):
@@ -642,6 +694,23 @@ class MetricCollection(dict):
             return traced
 
         if not coll_disp.probed(key):
+            # metricslint pre-classification, member-attributed: statically
+            # dirty members mark THEIR OWN fallback with the definition-time
+            # diagnostic (the next step's eligibility pass fuses the rest
+            # under a new key); an all-clean roster skips the fused probe.
+            dirty_members = 0
+            all_clean = True
+            for _k, m in pairs:
+                m_verdict, m_detail = consult_static([(m, ("update",))])
+                if m_verdict == "dirty":
+                    m._compiled_dispatcher().mark_fallback("update", m_detail)
+                    dirty_members += 1
+                all_clean = all_clean and m_verdict == "clean"
+            if dirty_members:
+                return set()
+            if all_clean:
+                coll_disp.mark_probed(key)
+        if not coll_disp.probed(key):
             reason = probe_traceable(
                 build(),
                 {k: dict(m._state) for k, m in pairs},
@@ -772,10 +841,22 @@ class MetricCollection(dict):
             return traced
 
         if not coll_disp.probed(key):
-            reason = probe_traceable(build(), dict(source._state), dynamic, members)
-            if reason is not None:
-                coll_disp.mark_fallback(fkind, reason)
+            # metricslint pre-classification for the group forward: the one
+            # program traces source's update + merge and EVERY on-step
+            # member's compute, so all of those must be statically clean to
+            # skip the probe; a dirty verdict falls back with the
+            # definition-time diagnostic.
+            verdict, detail = consult_static(
+                [(source, ("update", "merge"))] + [(p, ("compute",)) for p in on_step]
+            )
+            if verdict == "dirty":
+                coll_disp.mark_fallback(fkind, detail)
                 return None
+            if verdict != "clean":
+                reason = probe_traceable(build(), dict(source._state), dynamic, members)
+                if reason is not None:
+                    coll_disp.mark_fallback(fkind, reason)
+                    return None
             coll_disp.mark_probed(key)
         prog = coll_disp.program(key, build)
         source._ensure_donation_safe()

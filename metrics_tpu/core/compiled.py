@@ -286,6 +286,28 @@ def probe_traceable(fn: Callable, state: Any, dynamic: Sequence, owners: Sequenc
     return None
 
 
+def consult_static(pairs) -> Tuple[str, Optional[str]]:
+    """metricslint pre-classification for an eligibility probe: aggregate
+    ``(metric, kinds)`` pairs into ``("clean"|"dirty"|"unknown", detail)``.
+
+    ``clean`` means every instance's class was statically verified (writes
+    only declared states, no host-sync antipatterns, fully resolved scan) —
+    the ``jax.eval_shape`` probe is redundant and may be skipped; a residual
+    trace failure still recovers to eager via :func:`dispatch_program`
+    (trace errors precede any buffer consumption). ``dirty`` means the
+    static report *refuted* eligibility — ``detail`` names the offending
+    attribute and source line, the definition-time diagnostic that replaces
+    the generic probe message. ``unknown`` (unresolvable source, dynamic
+    writes, ``METRICS_TPU_ANALYSIS_PRECLASSIFY=0``) keeps the runtime probe
+    as the last word, exactly the pre-classification-free behavior.
+    """
+    try:
+        from metrics_tpu.analysis.runtime import static_probe_verdict_many
+    except Exception:  # pragma: no cover - analysis package always ships
+        return "unknown", None
+    return static_probe_verdict_many(pairs)
+
+
 _compile_cache_checked = False
 
 

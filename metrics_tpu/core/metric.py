@@ -46,6 +46,7 @@ from metrics_tpu.core.compiled import (
     CompiledDispatcher,
     compiled_update_enabled,
     compiled_warmup,
+    consult_static,
     dispatch_program,
     probe_traceable,
     rebuild_call,
@@ -1255,10 +1256,21 @@ class Metric:
             return traced
 
         if not disp.probed(key):
-            reason = probe_traceable(build(), dict(self._state), dynamic, [self])
-            if reason is not None:
-                disp.mark_fallback(kind, reason)
+            # metricslint pre-classification: a statically-verified class
+            # skips the eval_shape probe (results bit-identical — the probe
+            # only ever *refuses*, never changes what the program computes);
+            # a statically-refuted one falls back immediately with a
+            # definition-time diagnostic naming the attribute and line.
+            kinds = ("update",) if kind == "update" else ("update", "compute", "merge")
+            verdict, detail = consult_static([(self, kinds)])
+            if verdict == "dirty":
+                disp.mark_fallback(kind, detail)
                 return False, None
+            if verdict != "clean":
+                reason = probe_traceable(build(), dict(self._state), dynamic, [self])
+                if reason is not None:
+                    disp.mark_fallback(kind, reason)
+                    return False, None
             disp.mark_probed(key)
         prog = disp.program(key, build)
         self._ensure_donation_safe()
@@ -1354,7 +1366,9 @@ class Metric:
                 # merging INTO a list state loses the overflow flag, so a
                 # corrupt buffer must fail here, loudly and with advice that
                 # fits a capacity-less metric (same policy as load_state_dict)
-                if not is_traced(b.overflowed) and bool(b.overflowed):
+                # the bool() below runs only on CONCRETE flags — the
+                # is_traced() guard keeps the traced path sync-free
+                if not is_traced(b.overflowed) and bool(b.overflowed):  # metricslint: disable=host-sync-in-update
                     raise MetricsTPUUserError(
                         f"State {name!r} holds a CatBuffer that overflowed inside "
                         "jit: its rows are corrupt and cannot be merged into a "

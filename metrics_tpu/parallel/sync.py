@@ -108,7 +108,14 @@ def sync_leaf_in_jit(value: Array, fx: ReduceFx, axis_name: str) -> Array:
     raise ValueError(f"Unknown dist_reduce_fx {fx!r}")
 
 
-def sync_in_jit(
+# metricslint: the empty-list skip below branches on per-rank data before
+# emitting collectives. That is legal ONLY here: this function runs at trace
+# time inside shard_map/pmap, where SPMD guarantees every device executes the
+# ONE traced program — python branches resolve once, identically, for the
+# whole mesh. Multi-HOST jit programs must feed every process identical state
+# schemas (empty vs non-empty included); the host path (host_sync_state)
+# verifies exactly that with the health header before its own collectives.
+def sync_in_jit(  # metricslint: disable=data-dependent-collective
     state: Dict[str, Any],
     reductions: Dict[str, ReduceFx],
     axis_name: str,
@@ -337,7 +344,13 @@ def host_sync_leaf(
     raise ValueError(f"Unknown dist_reduce_fx {fx!r}")
 
 
-def host_sync_state(
+# metricslint: the channel-suspect refusal below deliberately trades schedule
+# symmetry for safety AFTER a watchdog already fired: collective ordering is
+# known-poisoned at that point (the timed-out rank may still sit inside its
+# stale gather), so refusing to emit anything further — even though the latch
+# is per-process state — is strictly safer than emitting a collective that
+# could pair with the abandoned one. reset_channel_health() restores symmetry.
+def host_sync_state(  # metricslint: disable=data-dependent-collective
     state: Dict[str, Any],
     reductions: Dict[str, ReduceFx],
     update_count: int = 0,

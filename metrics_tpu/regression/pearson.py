@@ -93,15 +93,30 @@ class PearsonCorrcoef(Metric):
         )
 
     def merge_states(self, a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
-        if float(jnp.sum(jnp.atleast_1d(b["n_total"]))) == 0:
-            return dict(a)
-        if float(jnp.sum(jnp.atleast_1d(a["n_total"]))) == 0:
-            return dict(b)
+        """Empty-side-aware pairwise merge, fully traceable.
+
+        Historically this early-returned on ``float(jnp.sum(...)) == 0`` —
+        a device→host sync on every ``forward()`` step that also made the
+        merge untraceable, so the compiled forward path could never engage
+        for Pearson (metricslint: host-sync-in-update). The empty-side
+        selection is now a ``jnp.where`` over the merged result: same
+        values, no host round-trip, one traceable program.
+        """
+        n_a = jnp.sum(jnp.atleast_1d(a["n_total"]))
+        n_b = jnp.sum(jnp.atleast_1d(b["n_total"]))
+        a_empty, b_empty = n_a == 0, n_b == 0
+        # a both-empty merge divides 0/0 inside _merge_two; feed it a dummy
+        # count so no NaN is ever produced — the result is select()ed away
+        n2 = jnp.where(a_empty & b_empty, jnp.ones_like(jnp.asarray(b["n_total"])), b["n_total"])
         mx, my, vx, vy, cxy, n = _merge_two(
             a["mean_x"], a["mean_y"], a["var_x"], a["var_y"], a["corr_xy"], a["n_total"],
-            b["mean_x"], b["mean_y"], b["var_x"], b["var_y"], b["corr_xy"], b["n_total"],
+            b["mean_x"], b["mean_y"], b["var_x"], b["var_y"], b["corr_xy"], n2,
         )
-        return {"mean_x": mx, "mean_y": my, "var_x": vx, "var_y": vy, "corr_xy": cxy, "n_total": n}
+        merged = {"mean_x": mx, "mean_y": my, "var_x": vx, "var_y": vy, "corr_xy": cxy, "n_total": n}
+        return {
+            k: jnp.where(b_empty, a[k], jnp.where(a_empty, b[k], merged[k]))
+            for k in merged
+        }
 
     def compute(self) -> Array:
         if self.mean_x.ndim > 0 and self.mean_x.shape[0] > 1:

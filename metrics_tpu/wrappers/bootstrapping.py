@@ -45,6 +45,14 @@ class BootStrapper(Metric):
         0.01
     """
 
+    #: ``update`` advances the resampling PRNG key — an instance-attribute
+    #: side effect, declared so the static contract checker (metricslint)
+    #: and the compute-group/compiled machinery know about the latch. The
+    #: wrapper never joins a compute group (no ``update_identity``) and its
+    #: nested metrics already exclude it from compiled dispatch, so the
+    #: declaration is purely the honest contract.
+    _group_shared_attrs = ("_key",)
+
     def __init__(
         self,
         base_metric: Metric,

@@ -1,0 +1,75 @@
+"""metricslint fixture: a fully clean metric module — the CLI must exit 0.
+
+Exercises the patterns the rules must NOT fire on: loop-declared states,
+conditional (if/else) schema alternatives, declared shared-attr latches with
+a redeclared identity, schema-only branching, and host work on untraced
+(unannotated, host-side) inputs.
+"""
+import jax.numpy as jnp
+from jax import Array
+
+STATE_CONSTANT = "extra"
+
+
+class CleanBase:
+    _group_shared_attrs = ("mode",)
+
+    def __init__(self, samplewise: bool = False):
+        for s in ("tp", "fp"):
+            self.add_state(s, jnp.zeros(()), dist_reduce_fx="sum")
+        if samplewise:
+            self.add_state("scores", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("scores", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state(STATE_CONSTANT, jnp.zeros(()), dist_reduce_fx="sum")
+        self.mode = None
+
+    def add_state(self, *a, **k):
+        pass
+
+    def update_identity(self):
+        return ("clean", 1)
+
+    def update(self, preds: Array, target: Array):
+        if preds.ndim == 1:  # schema branch: static under tracing
+            preds = preds[None]
+        self.mode = "binary"  # declared shared latch
+        self.tp = self.tp + jnp.sum(preds * target)
+        self.fp = self.fp + jnp.sum(preds * (1 - target))
+        if isinstance(self.scores, list):
+            self.scores.append(jnp.sum(preds))
+        else:
+            self.scores = self.scores + jnp.sum(preds)
+        self.extra = self.extra + 1
+
+    def compute(self):
+        return self.tp / (self.tp + self.fp)
+
+
+class CleanOverride(CleanBase):
+    """overrides update AND redeclares the identity: hygiene satisfied."""
+
+    def update_identity(self):
+        return ("clean-override", 1)
+
+    def update(self, preds: Array, target: Array):
+        self.tp = self.tp + jnp.sum(preds * target)
+        self.fp = self.fp + jnp.sum(preds * (1 - target))
+
+
+class HostSideText:
+    """unannotated host-side inputs (strings): float()/len() are legitimate
+    and must not be flagged by the annotation-seeded CLI taint."""
+
+    def __init__(self):
+        self.add_state("errors", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def add_state(self, *a, **k):
+        pass
+
+    def update(self, preds, target):
+        score = float(len(preds)) / max(float(len(target)), 1.0)
+        self.errors = self.errors + jnp.asarray(score)
+
+    def compute(self):
+        return self.errors

@@ -1,0 +1,64 @@
+"""metricslint fixture: real violations, every one suppressed — the CLI
+must exit 0. Exercises all three suppression forms: same-line, line-above,
+and def-line (whole-function) scope, plus the ``all`` wildcard.
+"""
+import jax
+import jax.numpy as jnp
+from jax import Array
+
+
+def _process_allgather(x, timeout=None):
+    return jnp.asarray(x)[None]
+
+
+class SameLineSuppressed:
+    def __init__(self):
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def add_state(self, *a, **k):
+        pass
+
+    def update(self, x: Array):
+        self.seen = True  # metricslint: disable=undeclared-state
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+class LineAboveSuppressed:
+    def __init__(self):
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def add_state(self, *a, **k):
+        pass
+
+    def update(self, x: Array):
+        # metricslint: disable=host-sync-in-update
+        _ = float(jnp.sum(x))
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+class DefLineSuppressed:
+    def __init__(self):
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def add_state(self, *a, **k):
+        pass
+
+    def update(self, x: Array):  # metricslint: disable=all
+        self.calls = 1
+        _ = float(jnp.sum(x))
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+def rank_guarded_but_waived(x):  # metricslint: disable=rank-dependent-collective
+    if jax.process_index() == 0:
+        return _process_allgather(x)
+    return x

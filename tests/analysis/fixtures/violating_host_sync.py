@@ -1,0 +1,87 @@
+"""metricslint fixture: host-sync antipatterns inside update hot paths.
+
+The CI gate asserts the CLI exits NONZERO on this file.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import Array
+
+
+class FloatOnTraced:
+    def __init__(self):
+        self.add_state("pos", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def add_state(self, *a, **k):
+        pass
+
+    def update(self, preds: Array):
+        if float(jnp.sum(preds)) > 0:  # finding: host-sync-in-update
+            self.pos = self.pos + jnp.sum(preds)
+
+    def compute(self):
+        return self.pos
+
+
+class ItemOnState:
+    def __init__(self):
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def add_state(self, *a, **k):
+        pass
+
+    def update(self, preds: Array):
+        self.total = self.total + jnp.sum(preds)
+        _ = self.total.item()  # finding: host-sync-in-update
+
+    def compute(self):
+        return self.total
+
+
+class NumpyRoundTrip:
+    def __init__(self):
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def add_state(self, *a, **k):
+        pass
+
+    def update(self, preds: Array):
+        host = np.asarray(preds)  # finding: host-sync-in-update
+        self.total = self.total + jnp.sum(jnp.asarray(host))
+
+    def compute(self):
+        return self.total
+
+
+class DeviceGetInUpdate:
+    def __init__(self):
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def add_state(self, *a, **k):
+        pass
+
+    def update(self, preds: Array):
+        host = jax.device_get(preds)  # finding: host-sync-in-update
+        self.total = self.total + jnp.sum(jnp.asarray(host))
+
+    def compute(self):
+        return self.total
+
+
+class TaintThroughLocals:
+    """the sync target is two assignments away from the traced input."""
+
+    def __init__(self):
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def add_state(self, *a, **k):
+        pass
+
+    def update(self, preds: Array):
+        scaled = preds * 2.0
+        summed = jnp.sum(scaled)
+        _ = int(summed)  # finding: host-sync-in-update (via taint chain)
+        self.total = self.total + summed
+
+    def compute(self):
+        return self.total

@@ -1,0 +1,95 @@
+"""Importable Metric subclasses for the probe/planner integration tests.
+
+These live in a real module file (not a test body) because the runtime
+bridge resolves a class's source via ``inspect.getsourcefile`` — classes
+defined in a REPL or exec'd string stay "unknown" by design.
+"""
+import jax.numpy as jnp
+
+from metrics_tpu.core.metric import Metric
+
+
+class CleanSum(Metric):
+    """Straight-line declared-state update: statically verifiable clean."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + x.shape[0]
+
+    def compute(self):
+        return self.total / jnp.maximum(self.count, 1)
+
+
+class LeakyLatch(Metric):
+    """update writes an undeclared attribute: statically refutable dirty."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.last_shape = None
+
+    def update(self, x):
+        self.last_shape = x.shape
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+class GroupableClean(Metric):
+    """Declares an update_identity and honors the grouping contract."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update_identity(self):
+        return ("groupable-clean",)
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+class GroupableLeaky(Metric):
+    """Declares an update_identity but latches an undeclared attribute —
+    the static report must refute its grouping claim."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.rows_seen = 0
+
+    def update_identity(self):
+        return ("groupable-leaky",)
+
+    def update(self, x):
+        self.rows_seen += x.shape[0]
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+class BranchyUnannotated(Metric):
+    """Value-dependent python branch with UNANNOTATED params: must stay
+    'unknown' (probed), never 'clean' — and never 'dirty' either, since
+    eager semantics are perfectly legal."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("pos", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        if float(jnp.sum(x)) > 0:
+            self.pos = self.pos + jnp.sum(x)
+
+    def compute(self):
+        return self.pos

@@ -1,0 +1,85 @@
+"""CLI contract: exit codes, output format, --list-rules, error handling.
+
+The CI gate runs the same commands over the package (exit 0) and the
+violation fixtures (exit nonzero); these tests pin that contract in-process
+(plus one true subprocess run for the ``python -m`` entry itself).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+from metrics_tpu.analysis.__main__ import main
+from metrics_tpu.analysis.report import RULES
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+VIOLATING = sorted(
+    os.path.join(FIXTURES, n) for n in os.listdir(FIXTURES) if n.startswith("violating_")
+)
+
+
+def test_violating_fixtures_exit_nonzero(capsys):
+    assert VIOLATING, "violation fixtures missing"
+    for path in VIOLATING:
+        assert main([path]) == 1, f"{path} must fail the lint"
+        out = capsys.readouterr().out
+        assert os.path.basename(path) in out  # findings carry the path
+
+
+def test_clean_and_suppressed_fixtures_exit_zero(capsys):
+    assert main([os.path.join(FIXTURES, "clean_metric.py")]) == 0
+    assert main([os.path.join(FIXTURES, "suppressed_metric.py")]) == 0
+
+
+def test_finding_format_is_path_line_col_rule(capsys):
+    main([os.path.join(FIXTURES, "violating_undeclared_state.py")])
+    first = capsys.readouterr().out.splitlines()[0]
+    path, line, col, rule = first.split(":", 3)
+    assert path.endswith("violating_undeclared_state.py")
+    assert int(line) > 0 and int(col) >= 0
+    assert rule.strip().startswith("undeclared-state")
+
+
+def test_list_rules_prints_catalog(capsys):
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in RULES:
+        assert rule in out
+
+
+def test_missing_path_is_usage_error(capsys):
+    assert main([os.path.join(FIXTURES, "no_such_file.py")]) == 2
+
+
+def test_unparsable_file_exits_nonzero(tmp_path, capsys):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def update(:\n")
+    assert main([str(bad)]) == 1
+    assert "SyntaxError" in capsys.readouterr().err
+
+
+def test_no_schedule_flag_skips_schedule_rules(capsys):
+    path = os.path.join(FIXTURES, "violating_schedule.py")
+    assert main([path]) == 1
+    capsys.readouterr()
+    assert main([path, "--no-schedule"]) == 0
+
+
+def test_package_gate_via_module_subprocess():
+    """The exact CI command: ``python -m metrics_tpu.analysis metrics_tpu/``
+    exits 0 on the shipped package and 1 on a violation fixture."""
+    import metrics_tpu
+
+    pkg = os.path.dirname(metrics_tpu.__file__)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, "-m", "metrics_tpu.analysis", pkg],
+        capture_output=True, text=True, env=env,
+    )
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(
+        [sys.executable, "-m", "metrics_tpu.analysis", VIOLATING[0]],
+        capture_output=True, text=True, env=env,
+    )
+    assert bad.returncode == 1, bad.stdout + bad.stderr

@@ -1,0 +1,199 @@
+"""metricslint metric-class pass: rule-by-rule coverage over the violation /
+clean / suppressed fixtures plus inline sources for the edge cases."""
+import os
+
+import pytest
+
+from metrics_tpu.analysis import analyze_paths, analyze_source
+from metrics_tpu.analysis.metric_pass import RUNTIME_EXEMPT_ATTRS
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+
+
+def fixture(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def analyze_fixture(name: str):
+    findings, errors = analyze_paths([fixture(name)])
+    assert not errors
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# fixture files: each violating file trips exactly its rules, clean trips none
+# ---------------------------------------------------------------------------
+
+def test_undeclared_state_fixture_variants():
+    findings = analyze_fixture("violating_undeclared_state.py")
+    assert rules_of(findings) == {"undeclared-state"}
+    attrs = {f.attr for f in findings}
+    # plain assign, in-place append, in-place [k]=, aug-assign, helper write,
+    # compute-side write — every variant is caught
+    assert attrs == {"seen", "shapes", "by_kind", "calls", "last_batch", "cached"}
+    # declared states never fire
+    assert not any(f.attr in ("total", "rows") for f in findings)
+
+
+def test_host_sync_fixture_variants():
+    findings = analyze_fixture("violating_host_sync.py")
+    assert rules_of(findings) == {"host-sync-in-update"}
+    msgs = " | ".join(f.message for f in findings)
+    for needle in ("float()", ".item()", "np.asarray", "device_get", "int()"):
+        assert needle in msgs, f"missing variant: {needle}"
+
+
+def test_hygiene_fixture_variants():
+    findings = analyze_fixture("violating_hygiene.py")
+    assert rules_of(findings) == {
+        "update-identity-redeclare", "unshared-latch", "state-default",
+    }
+    defaults = [f for f in findings if f.rule == "state-default"]
+    joined = " | ".join(f.message for f in defaults)
+    for needle in ("EMPTY list", "'prod'", "growing list", "0-d default", "duplicate"):
+        assert needle in joined, f"missing state-default variant: {needle}"
+    latch = next(f for f in findings if f.rule == "unshared-latch")
+    assert latch.attr == "num_classes"
+
+
+def test_clean_fixture_has_no_findings():
+    assert analyze_fixture("clean_metric.py") == []
+
+
+def test_suppressed_fixture_has_no_findings():
+    assert analyze_fixture("suppressed_metric.py") == []
+
+
+def test_suppression_is_rule_specific():
+    src = open(fixture("suppressed_metric.py")).read()
+    # narrow the same-line suppression to the WRONG rule: finding comes back
+    bad = src.replace(
+        "# metricslint: disable=undeclared-state", "# metricslint: disable=state-default"
+    )
+    findings = analyze_source(bad, "suppressed_metric.py")
+    assert "undeclared-state" in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# inline edge cases
+# ---------------------------------------------------------------------------
+
+SNIPPET = '''
+import jax.numpy as jnp
+
+class M:
+    def __init__(self):
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+    def add_state(self, *a, **k):
+        pass
+    def update(self, x):
+        {body}
+    def compute(self):
+        return self.total
+'''
+
+
+def _one(body: str):
+    return analyze_source(SNIPPET.format(body=body), "<snippet>")
+
+
+def test_runtime_bookkeeping_attrs_are_exempt():
+    assert _one("self._update_count = 3; self.total = self.total + jnp.sum(x)") == []
+
+
+def test_setattr_with_constant_name_is_caught():
+    findings = _one('setattr(self, "latch", 1); self.total = self.total + jnp.sum(x)')
+    assert [f.attr for f in findings] == ["latch"]
+
+
+def test_dynamic_state_names_stay_silent():
+    # add_state name built dynamically: the declared set is unknowable, so
+    # the mutation rules must not guess
+    src = '''
+import jax.numpy as jnp
+
+class M:
+    def __init__(self, keys):
+        for k in keys:
+            self.add_state(f"{k}_sum", jnp.zeros(()), dist_reduce_fx="sum")
+    def add_state(self, *a, **k):
+        pass
+    def update(self, x):
+        self.anything = 1
+    def compute(self):
+        return 0
+'''
+    assert analyze_source(src, "<snippet>") == []
+
+
+def test_conditional_alternative_declarations_are_not_duplicates():
+    src = '''
+import jax.numpy as jnp
+
+class M:
+    def __init__(self, samplewise):
+        if samplewise:
+            self.add_state("v", [], dist_reduce_fx="cat")
+        else:
+            self.add_state("v", jnp.zeros(()), dist_reduce_fx="sum")
+    def add_state(self, *a, **k):
+        pass
+    def update(self, x):
+        self.v = self.v + jnp.sum(x)
+    def compute(self):
+        return self.v
+'''
+    assert analyze_source(src, "<snippet>") == []
+
+
+def test_cross_file_inheritance_resolves_states(tmp_path):
+    base = tmp_path / "base_mod.py"
+    base.write_text('''
+import jax.numpy as jnp
+
+class Base:
+    def __init__(self):
+        for s in ("tp", "fp"):
+            self.add_state(s, jnp.zeros(()), dist_reduce_fx="sum")
+    def add_state(self, *a, **k):
+        pass
+    def update(self, x):
+        self.tp = self.tp + 1
+    def compute(self):
+        return self.tp
+''')
+    child = tmp_path / "child_mod.py"
+    child.write_text('''
+from base_mod import Base
+
+class Child(Base):
+    def update(self, x):
+        self.fp = self.fp + 1   # declared in the OTHER file's Base
+        self.stray = 1          # finding
+''')
+    findings, errors = analyze_paths([str(tmp_path)])
+    assert not errors
+    assert [(f.rule, f.attr) for f in findings] == [("undeclared-state", "stray")]
+
+
+def test_exempt_set_matches_runtime_probe():
+    """The AST pass must never flag what the runtime probe exempts — the
+    static copy has to stay a superset of core.compiled._PROBE_EXEMPT."""
+    from metrics_tpu.core.compiled import _PROBE_EXEMPT
+
+    missing = set(_PROBE_EXEMPT) - set(RUNTIME_EXEMPT_ATTRS)
+    assert not missing, f"RUNTIME_EXEMPT_ATTRS is missing {sorted(missing)}"
+
+
+def test_shipped_package_is_clean():
+    """The acceptance gate, as a test: the CLI contract over metrics_tpu/."""
+    import metrics_tpu
+
+    pkg = os.path.dirname(metrics_tpu.__file__)
+    findings, errors = analyze_paths([pkg])
+    assert not errors
+    assert findings == [], "\n".join(f.format() for f in findings)

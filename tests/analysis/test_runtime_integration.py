@@ -1,0 +1,316 @@
+"""Probe pre-classification + compute-group planner integration.
+
+The acceptance contract: statically-verified classes skip the runtime
+``jax.eval_shape`` probe with results BIT-IDENTICAL to the probed path;
+statically-refuted classes fall back with a definition-time diagnostic
+naming the attribute and source line; the planner screens compute-group
+candidates against the static report; and
+``METRICS_TPU_ANALYSIS_PRECLASSIFY=0`` restores the pre-lint behavior.
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.core.collections as coll_mod
+import metrics_tpu.core.metric as metric_mod
+from metrics_tpu import MeanSquaredError, MetricCollection, Precision, Recall
+from metrics_tpu.analysis.runtime import clear_cache, static_probe_verdict
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+from tests.analysis.runtime_fixtures import (
+    BranchyUnannotated,
+    CleanSum,
+    GroupableClean,
+    GroupableLeaky,
+    LeakyLatch,
+)
+
+BATCHES = [np.linspace(0.0, 1.0, 32).astype(np.float32) * (i + 1) for i in range(4)]
+
+
+@pytest.fixture()
+def probe_counter(monkeypatch):
+    calls = []
+    orig = metric_mod.probe_traceable
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(metric_mod, "probe_traceable", counting)
+    monkeypatch.setattr(coll_mod, "probe_traceable", counting)
+    return calls
+
+
+# ---------------------------------------------------------------------------
+# verdicts
+# ---------------------------------------------------------------------------
+
+def test_fixture_class_verdicts():
+    assert static_probe_verdict(CleanSum(), ("update",))[0] == "clean"
+    verdict, detail = static_probe_verdict(LeakyLatch(), ("update",))
+    assert verdict == "dirty"
+    assert "last_shape" in detail and "runtime_fixtures.py" in detail
+    # legal-eager value branch: unknown, so the probe keeps the last word
+    assert static_probe_verdict(BranchyUnannotated(), ("update",))[0] == "unknown"
+
+
+def test_shipped_class_verdicts():
+    assert static_probe_verdict(MeanSquaredError(), ("update",))[0] == "clean"
+    assert static_probe_verdict(Precision(), ("update",))[0] == "clean"
+    assert (
+        static_probe_verdict(MeanSquaredError(), ("update", "compute", "merge"))[0]
+        == "clean"
+    )
+
+
+def test_escape_hatch_disables_preclassification(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_ANALYSIS_PRECLASSIFY", "0")
+    assert static_probe_verdict(CleanSum(), ("update",))[0] == "unknown"
+    assert static_probe_verdict(LeakyLatch(), ("update",))[0] == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# probe skip, bit-identical results
+# ---------------------------------------------------------------------------
+
+def test_clean_class_skips_probe(probe_counter):
+    m = CleanSum()
+    m.compiled_update = True
+    for b in BATCHES:
+        m.update(jnp.asarray(b))
+    stats = m.compile_stats()
+    assert probe_counter == [], "statically-clean class must not probe"
+    assert stats["dispatches"] == len(BATCHES) and stats["fallback"] is None
+
+
+def test_probe_skip_results_bit_identical(probe_counter, monkeypatch):
+    def run():
+        m = CleanSum()
+        m.compiled_update = True
+        for b in BATCHES:
+            m.update(jnp.asarray(b))
+        return {k: np.asarray(v) for k, v in m._state.items()}, float(m.compute())
+
+    skipped_state, skipped_value = run()
+    n_skip = len(probe_counter)
+    monkeypatch.setenv("METRICS_TPU_ANALYSIS_PRECLASSIFY", "0")
+    probed_state, probed_value = run()
+    assert n_skip == 0 and len(probe_counter) > 0  # the probe really ran only once enabled
+    assert skipped_value == probed_value
+    for k in probed_state:
+        np.testing.assert_array_equal(skipped_state[k], probed_state[k])
+        assert skipped_state[k].dtype == probed_state[k].dtype
+
+
+def test_dirty_class_definition_time_diagnostic(probe_counter):
+    m = LeakyLatch()
+    m.compiled_update = True
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for b in BATCHES:
+            m.update(jnp.asarray(b))
+    assert probe_counter == [], "statically-dirty class must not probe either"
+    reason = m.compile_stats()["fallback"]["update"]
+    assert "last_shape" in reason and "runtime_fixtures.py:" in reason
+    # ... and the eager path kept the latch + values correct
+    assert m.last_shape == (32,)
+    np.testing.assert_allclose(
+        float(m.compute()), sum(float(np.sum(b)) for b in BATCHES), rtol=1e-5
+    )
+    msgs = [str(w.message) for w in caught if "compiled eager" in str(w.message)]
+    assert len(msgs) == 1 and "last_shape" in msgs[0]
+
+
+def test_unknown_class_still_probes(probe_counter):
+    m = BranchyUnannotated()
+    m.compiled_update = True
+    m.update(jnp.asarray(BATCHES[0]))
+    assert len(probe_counter) == 1, "unknown verdict keeps the probe in the loop"
+    assert "not traceable" in m.compile_stats()["fallback"]["update"]
+
+
+def test_collection_fused_update_skips_probe_when_all_clean(probe_counter):
+    mc = MetricCollection({"mse": MeanSquaredError(), "prec": Precision(num_classes=2)})
+    for m in mc.values():
+        m.compiled_update = True
+    rng = np.random.RandomState(0)
+    for _ in range(4):
+        preds = jnp.asarray(rng.rand(16).astype(np.float32))
+        target = jnp.asarray((rng.rand(16) > 0.5).astype(np.int32))
+        mc.update(preds, target)
+    assert probe_counter == []
+    cs = mc.compile_stats()
+    assert cs["collection"]["dispatches"] == 4
+
+
+# ---------------------------------------------------------------------------
+# compute-group planner screening
+# ---------------------------------------------------------------------------
+
+def test_planner_groups_clean_identity_classes():
+    mc = MetricCollection({"a": GroupableClean(), "b": GroupableClean()})
+    mc.update(jnp.asarray(BATCHES[0]))
+    assert mc.compute_group_keys == [["a", "b"]]
+
+
+def test_planner_excludes_statically_refuted_class():
+    # the hazard warning fires once per class per process: reset for order-
+    # independence (pytest-randomly etc.)
+    coll_mod._static_hazard_warned.discard(GroupableLeaky)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        mc = MetricCollection({"a": GroupableLeaky(), "b": GroupableLeaky()})
+        mc.update(jnp.asarray(BATCHES[0]))
+    assert mc.compute_group_keys == [], "hazardous class must stay solo"
+    msgs = [str(w.message) for w in caught if "excluded from compute groups" in str(w.message)]
+    assert msgs and "rows_seen" in msgs[0]
+    # results stay correct, each member keeps its own latch
+    for m in mc.values():
+        assert m.rows_seen == 32
+        np.testing.assert_allclose(float(m.compute()), float(np.sum(BATCHES[0])), rtol=1e-5)
+
+
+def test_explicit_group_override_refuted_loudly():
+    with pytest.raises(MetricsTPUUserError, match="rows_seen"):
+        MetricCollection(
+            {"a": GroupableLeaky(), "b": GroupableLeaky()},
+            compute_groups=[["a", "b"]],
+        ).update(jnp.asarray(BATCHES[0]))
+
+
+def test_planner_screen_disabled_by_escape_hatch(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_ANALYSIS_PRECLASSIFY", "0")
+    mc = MetricCollection({"a": GroupableLeaky(), "b": GroupableLeaky()})
+    mc.update(jnp.asarray(BATCHES[0]))
+    assert mc.compute_group_keys == [["a", "b"]], "pre-lint behavior restored"
+
+
+def test_alias_mutation_is_not_verdicted_clean(tmp_path, monkeypatch):
+    """Review finding: `buf = self.latch; buf.append(x)` must never produce
+    a 'clean' verdict — the skipped probe would let the compiled replay drop
+    the append silently."""
+    mod = tmp_path / "alias_fixture_mod.py"
+    mod.write_text(
+        "import jax.numpy as jnp\n"
+        "from metrics_tpu.core.metric import Metric\n\n"
+        "class AliasLatch(Metric):\n"
+        "    def __init__(self):\n"
+        "        super().__init__()\n"
+        "        self.add_state('total', jnp.zeros(()), dist_reduce_fx='sum')\n"
+        "        self.seen = []\n"
+        "    def update(self, x):\n"
+        "        buf = self.seen\n"
+        "        buf.append(int(x.shape[0]))\n"
+        "        self.total = self.total + jnp.sum(x)\n"
+        "    def compute(self):\n"
+        "        return self.total\n"
+    )
+    import sys
+
+    monkeypatch.syspath_prepend(str(tmp_path))
+    sys.modules.pop("alias_fixture_mod", None)
+    from alias_fixture_mod import AliasLatch
+
+    verdict, detail = static_probe_verdict(AliasLatch(), ("update",))
+    assert verdict == "dirty" and "seen" in detail
+    # end to end: eager fallback keeps the latch advancing every step
+    m = AliasLatch()
+    m.compiled_update = True
+    for b in BATCHES:
+        m.update(jnp.asarray(b))
+    assert m.seen == [32] * len(BATCHES)
+    sys.modules.pop("alias_fixture_mod", None)
+
+
+def test_self_writing_merge_states_is_not_verdicted_clean(tmp_path, monkeypatch):
+    """Review finding: a merge_states that writes self must demote the
+    forward verdict to 'unknown' — the compiled forward runs the merge
+    functionally and would skip the write."""
+    mod = tmp_path / "merge_fixture_mod.py"
+    mod.write_text(
+        "import jax.numpy as jnp\n"
+        "from metrics_tpu.core.metric import Metric\n\n"
+        "class MergeCounter(Metric):\n"
+        "    def __init__(self):\n"
+        "        super().__init__()\n"
+        "        self.add_state('total', jnp.zeros(()), dist_reduce_fx='sum')\n"
+        "        self.merges = 0\n"
+        "    def update(self, x):\n"
+        "        self.total = self.total + jnp.sum(x)\n"
+        "    def merge_states(self, a, b):\n"
+        "        self.merges = self.merges + 1\n"
+        "        return {'total': a['total'] + b['total']}\n"
+        "    def compute(self):\n"
+        "        return self.total\n"
+    )
+    import sys
+
+    monkeypatch.syspath_prepend(str(tmp_path))
+    sys.modules.pop("merge_fixture_mod", None)
+    from merge_fixture_mod import MergeCounter
+
+    assert static_probe_verdict(MergeCounter(), ("update",))[0] == "clean"
+    assert (
+        static_probe_verdict(MergeCounter(), ("update", "compute", "merge"))[0]
+        == "unknown"
+    )
+    # end to end: the probe refuses forward compilation, eager keeps the count
+    m = MergeCounter()
+    m.compiled_update = True
+    for b in BATCHES:
+        m(jnp.asarray(b))
+    assert m.merges == len(BATCHES)
+    sys.modules.pop("merge_fixture_mod", None)
+
+
+def test_mutable_attr_leaked_to_opaque_callee_demotes(tmp_path, monkeypatch):
+    """`helper(self.latch)` with a mutable latch cannot stay 'clean' — the
+    callee may mutate it where the AST cannot see. Immutable config scalars
+    (the stat-score family's `self.reduce` etc.) must NOT demote."""
+    mod = tmp_path / "leak_fixture_mod.py"
+    mod.write_text(
+        "import jax.numpy as jnp\n"
+        "from metrics_tpu.core.metric import Metric\n\n"
+        "def _note(seen, x):\n"
+        "    seen.append(x)\n\n"
+        "class LeakyList(Metric):\n"
+        "    def __init__(self):\n"
+        "        super().__init__()\n"
+        "        self.add_state('total', jnp.zeros(()), dist_reduce_fx='sum')\n"
+        "        self.seen = []\n"
+        "    def update(self, x):\n"
+        "        _note(self.seen, 1)\n"
+        "        self.total = self.total + jnp.sum(x)\n"
+        "    def compute(self):\n"
+        "        return self.total\n\n"
+        "def _scaled(t, reduce):\n"
+        "    return jnp.sum(t)\n\n"
+        "class ScalarConfig(Metric):\n"
+        "    def __init__(self):\n"
+        "        super().__init__()\n"
+        "        self.add_state('total', jnp.zeros(()), dist_reduce_fx='sum')\n"
+        "        self.reduce = 'micro'\n"
+        "    def update(self, x):\n"
+        "        self.total = self.total + _scaled(x, self.reduce)\n"
+        "    def compute(self):\n"
+        "        return self.total\n"
+    )
+    import sys
+
+    monkeypatch.syspath_prepend(str(tmp_path))
+    for name in ("leak_fixture_mod",):
+        sys.modules.pop(name, None)
+    from leak_fixture_mod import LeakyList, ScalarConfig
+
+    assert static_probe_verdict(LeakyList(), ("update",))[0] == "unknown"
+    assert static_probe_verdict(ScalarConfig(), ("update",))[0] == "clean"
+    sys.modules.pop("leak_fixture_mod", None)
+
+
+def test_clear_cache_is_idempotent():
+    clear_cache()
+    assert static_probe_verdict(CleanSum(), ("update",))[0] == "clean"
+    clear_cache()

@@ -409,8 +409,26 @@ def test_undeclared_side_effect_latch_probe_fallback():
         eager.update(BPREDS[i])
         compiled.update(BPREDS[i])
     stats = compiled.compile_stats()
+    # metricslint pre-classification catches the latch statically — the
+    # definition-time diagnostic names the attribute (and the source line)
+    # instead of the probe's generic side-effect message
+    assert "seen_items" in stats["fallback"]["update"]
+    assert "metricslint" in stats["fallback"]["update"]
+    # the latch was never clobbered: the eager run derived it as usual
+    assert compiled.seen_items == eager.seen_items == BATCH
+    assert_states_equal(eager, compiled)
+
+
+def test_undeclared_latch_probe_fallback_without_preclassification(monkeypatch):
+    """METRICS_TPU_ANALYSIS_PRECLASSIFY=0 restores the pre-lint behavior:
+    the eval_shape probe discovers the latch and emits its own message."""
+    monkeypatch.setenv("METRICS_TPU_ANALYSIS_PRECLASSIFY", "0")
+    eager, compiled = LatchMetric(), set_compiled(LatchMetric(), True)
+    for i in range(3):
+        eager.update(BPREDS[i])
+        compiled.update(BPREDS[i])
+    stats = compiled.compile_stats()
     assert "side-effect latch" in stats["fallback"]["update"]
-    # the probe restored the attr before the eager run re-derived it
     assert compiled.seen_items == eager.seen_items == BATCH
     assert_states_equal(eager, compiled)
 

@@ -1,0 +1,81 @@
+"""Regression tests for the PearsonCorrcoef.merge_states host-sync fix.
+
+The merge used to early-return on ``float(jnp.sum(...)) == 0`` — a
+device→host sync inside every ``forward()`` step that also made the merge
+untraceable (metricslint: host-sync-in-update). It is now a ``jnp.where``
+selection: same values, traceable, and the compiled forward path can engage.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.regression.pearson import PearsonCorrcoef
+
+RNG = np.random.RandomState(7)
+PREDS = [jnp.asarray(RNG.randn(24).astype(np.float32)) for _ in range(4)]
+TARGET = [jnp.asarray(RNG.randn(24).astype(np.float32)) for _ in range(4)]
+
+
+def _state_after(m):
+    return {k: np.asarray(v) for k, v in m._state.items()}
+
+
+def _accumulated(n):
+    m = PearsonCorrcoef()
+    for p, t in zip(PREDS[:n], TARGET[:n]):
+        m.update(p, t)
+    return m
+
+
+def test_merge_states_empty_side_semantics():
+    full = _accumulated(2)
+    empty = PearsonCorrcoef()
+    # b empty -> a's values; a empty -> b's values; both empty -> defaults
+    merged_b_empty = full.merge_states(dict(full._state), dict(empty._state))
+    merged_a_empty = full.merge_states(dict(empty._state), dict(full._state))
+    both_empty = full.merge_states(dict(empty._state), dict(empty._state))
+    for k, v in full._state.items():
+        np.testing.assert_array_equal(np.asarray(merged_b_empty[k]), np.asarray(v))
+        np.testing.assert_array_equal(np.asarray(merged_a_empty[k]), np.asarray(v))
+        np.testing.assert_array_equal(np.asarray(both_empty[k]), 0.0)
+        assert not np.isnan(np.asarray(both_empty[k])).any()
+
+
+def test_merge_states_nonempty_matches_sequential():
+    a, b = _accumulated(2), PearsonCorrcoef()
+    for p, t in zip(PREDS[2:], TARGET[2:]):
+        b.update(p, t)
+    merged = a.merge_states(dict(a._state), dict(b._state))
+    m = a.clone()
+    m._state = dict(merged)
+    sequential = _accumulated(4)
+    np.testing.assert_allclose(float(m.compute()), float(sequential.compute()), rtol=1e-5)
+
+
+def test_merge_states_is_traceable():
+    """The old float()-guard raised ConcretizationTypeError under jit."""
+    m = _accumulated(2)
+    other = _accumulated(4)
+    jitted = jax.jit(m.merge_states)
+    out = jitted(dict(m._state), dict(other._state))
+    eager = m.merge_states(dict(m._state), dict(other._state))
+    for k in eager:
+        np.testing.assert_allclose(np.asarray(out[k]), np.asarray(eager[k]), rtol=1e-6)
+
+
+def test_forward_values_unchanged_and_compiled_path_engages():
+    eager = PearsonCorrcoef()
+    compiled = PearsonCorrcoef()
+    compiled.compiled_update = True
+    for p, t in zip(PREDS, TARGET):
+        v_eager = eager(p, t)
+        v_compiled = compiled(p, t)
+        np.testing.assert_allclose(np.asarray(v_compiled), np.asarray(v_eager), rtol=1e-6)
+    stats = compiled.compile_stats()
+    assert stats["fallback"] is None, stats["fallback"]
+    assert stats["dispatches"] >= 1, "compiled forward must actually engage"
+    s_e, s_c = _state_after(eager), _state_after(compiled)
+    for k in s_e:
+        np.testing.assert_allclose(s_c[k], s_e[k], rtol=1e-6)
+    np.testing.assert_allclose(float(compiled.compute()), float(eager.compute()), rtol=1e-6)
