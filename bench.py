@@ -1780,6 +1780,155 @@ def bench_config11() -> None:
     )
 
 
+def bench_config12() -> None:
+    """Config 12: async overlapped sync — overlapped vs blocking
+    compute()-every-N step-loop wall-clock + bit-identical resolved values.
+
+    The ISSUE-7 acceptance measurement: a sum-state metric runs the same
+    update stream at simulated W=8 over the LockstepWorld threads harness
+    (per-rank background executor lanes, rendezvous collectives with an
+    injected per-collective DCN delay, per-step simulated train work) in
+    two modes: blocking ``compute()`` every K steps (the gather stalls the
+    step loop) and ``sync_mode="overlap"`` (each compute resolves the round
+    launched one interval earlier and relaunches — the collective rides
+    behind the K steps of work). Asserts (CI gates contract):
+
+    - the overlapped step loop's wall-clock is strictly below blocking
+      (the collective is genuinely off the critical path);
+    - every overlapped resolve is **bit-identical** to the blocking sync of
+      the same update stream one interval earlier (staleness_policy
+      "snapshot": the consistent world cut, equal on every rank);
+    - both modes issue the SAME number of collective rounds — overlap moves
+      the same bytes, it just stops paying for them in step time;
+    - ``sync_stats()`` attributes the saving (``overlap_saved_s`` > 0).
+
+    Emits the overlapped/blocking wall-clock ratio with the per-knob
+    delays in the diagnostic for re-derivation.
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    import metrics_tpu.parallel.async_sync as async_mod
+    import metrics_tpu.parallel.sync as sync_mod
+    from metrics_tpu.core.metric import Metric
+    from metrics_tpu.parallel.bucketing import clear_sync_plan_cache
+    from tests.helpers.fake_world import LockstepWorld
+
+    W = 8
+    K_STEPS = 5  # train steps per compute interval
+    INTERVALS = 8
+    STEP_S = 0.002  # simulated per-step train work
+    GATHER_S = 0.003  # injected per-collective DCN round-trip
+
+    class _Sum(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + jnp.sum(x)
+
+        def compute(self):
+            return self.total
+
+    def run_mode(overlap: bool):
+        world = LockstepWorld(W)
+        real_allgather = world.allgather
+
+        def slow_allgather(x):
+            _time.sleep(GATHER_S)
+            return real_allgather(x)
+
+        saved = (
+            jax.process_count,
+            sync_mod._raw_process_allgather,
+            async_mod._get_executor,
+            async_mod._current_domain,
+        )
+        values = [[] for _ in range(W)]
+        stats = [None] * W
+        clear_sync_plan_cache()
+        try:
+            jax.process_count = lambda: W
+            sync_mod._raw_process_allgather = slow_allgather
+            async_mod._get_executor = world.executor_for_current_rank
+            async_mod._current_domain = world.rank_domain
+
+            def body(rank):
+                m = _Sum(
+                    sync_timeout=0,
+                    sync_mode="overlap" if overlap else "blocking",
+                    compiled_update=False,  # measure the sync path, not compile time
+                )
+                m.distributed_available_fn = lambda: True
+                t0 = _time.perf_counter()
+                for _ in range(INTERVALS):
+                    for _step in range(K_STEPS):
+                        _time.sleep(STEP_S)  # the "training step"
+                        m.update(jnp.asarray([float(rank + 1)]))
+                    values[rank].append(np.asarray(m.compute()).copy())
+                if m.__dict__.get("_inflight") is not None:
+                    m.unsync()  # drain the pipeline's tail round
+                elapsed = _time.perf_counter() - t0
+                stats[rank] = m.sync_stats()
+                return elapsed
+
+            elapsed = world.run(body, timeout=300.0)
+        finally:
+            (
+                jax.process_count,
+                sync_mod._raw_process_allgather,
+                async_mod._get_executor,
+                async_mod._current_domain,
+            ) = saved
+            world.shutdown_executors()
+            clear_sync_plan_cache()
+        return max(elapsed), values, world.calls, stats
+
+    wall_block, vals_block, calls_block, _ = run_mode(overlap=False)
+    wall_over, vals_over, calls_over, stats_over = run_mode(overlap=True)
+
+    # bit-identity: overlapped interval j serves the blocking world cut of
+    # interval j-1 (interval 0 is the documented local-only serve)
+    for rank in range(W):
+        for j in range(1, INTERVALS):
+            assert vals_over[rank][j].tobytes() == vals_block[rank][j - 1].tobytes(), (
+                rank, j, vals_over[rank][j], vals_block[rank][j - 1],
+            )
+    # the overlap moved the same collectives (same rounds, same bytes) —
+    # they just stopped stalling the step loop
+    assert calls_over == calls_block, (calls_over, calls_block)
+    assert wall_over < wall_block, (
+        f"overlapped step loop {wall_over * 1e3:.1f} ms not faster than "
+        f"blocking {wall_block * 1e3:.1f} ms"
+    )
+    saved_s = max(s["overlap_saved_s"] for s in stats_over)
+    assert saved_s > 0.0, stats_over[0]
+
+    _diag(
+        config=12,
+        world=W,
+        intervals=INTERVALS,
+        steps_per_interval=K_STEPS,
+        step_ms=STEP_S * 1e3,
+        gather_ms=GATHER_S * 1e3,
+        blocking_wall_ms=round(wall_block * 1e3, 2),
+        overlapped_wall_ms=round(wall_over * 1e3, 2),
+        collective_rounds={"blocking": calls_block, "overlapped": calls_over},
+        resolved=stats_over[0]["resolved"],
+        stale_resolves=stats_over[0]["stale_resolves"],
+        overlap_saved_ms=round(saved_s * 1e3, 2),
+    )
+    _emit(
+        "overlapped_sync_step_loop_ms",
+        round(wall_over * 1e3, 2),
+        "ms/loop",
+        round(wall_block / wall_over, 3),
+    )
+
+
 def main() -> None:
     try:
         platform = _ensure_backend()
@@ -1805,7 +1954,7 @@ def main() -> None:
     except Exception:
         vs = None
     _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
-    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10, "11": bench_config11}
+    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10, "11": bench_config11, "12": bench_config12}
     if "--config" in sys.argv:
         # comma-separated list (--config 9,11): related configs run in one
         # process and share compile-cache warmth (CI gates contract)

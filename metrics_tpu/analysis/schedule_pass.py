@@ -18,7 +18,12 @@ arbitrary Python):
 - **Collective primitives**: ``process_allgather`` (raw/watchdog-wrapped),
   ``lax.psum/pmean/pmax/pmin/all_gather``. A function that (transitively,
   within its module) calls one of these is *collective-emitting*; calling
-  it counts as emitting.
+  it counts as emitting. The cross-module host-sync entry points and the
+  async overlapped-round API (:data:`KNOWN_EMITTING_CALLS` —
+  ``host_sync_state``, ``launch_round``/``resolve_round``/``drain_round``,
+  …) count the same way: launching a background round schedules its
+  collectives at the launch point, so launch/resolve/drain ordering is
+  checked exactly as rank/data-independent as a direct gather.
 - **Symmetric values** (safe to branch on): literal/config values, world
   size (``jax.process_count``), env knobs, schema (``.shape``/``.dtype``/
   ``.ndim``/``.size`` — the sync-header protocol verifies schema equality
@@ -62,6 +67,27 @@ COLLECTIVE_CALLS = frozenset(
     }
 )
 
+#: cross-module calls that emit (or schedule/consume) collectives by module
+#: contract: the host-sync entry points and the async overlapped-round API
+#: (``parallel/async_sync.py``). The intra-module fixpoint cannot see across
+#: files, so these names are collective-emitting wherever they appear —
+#: launching a round schedules its collectives at the launch point's program
+#: order, and resolving/draining one completes them, so launch/resolve/drain
+#: call sites must be exactly as rank/data-independent as a direct
+#: ``process_allgather``. (Deliberately first-order: a local wrapper around
+#: one of these is not itself propagated — the wrapper's own body is checked
+#: instead.)
+KNOWN_EMITTING_CALLS = frozenset(
+    {
+        "host_sync_state",
+        "host_sync_leaf",
+        "host_sync_state_bucketed",
+        "launch_round",
+        "resolve_round",
+        "drain_round",
+    }
+)
+
 #: parameter names that carry per-rank data by module convention
 LOCAL_DATA_PARAMS = frozenset(
     {"state", "value", "values", "result", "x", "word", "update_count", "local_value"}
@@ -72,8 +98,9 @@ _LOCAL_CALLS = frozenset({"channel_is_suspect", "process_index", "build_health_w
 
 #: calls whose results are symmetric no matter the arguments (collective
 #: results are world-replicated; verify_health_words raises symmetrically
-#: from symmetric input and returns nothing asymmetric)
-_SYMMETRIC_CALLS = COLLECTIVE_CALLS | frozenset(
+#: from symmetric input and returns nothing asymmetric; a resolved round's
+#: gathered state is a collective result like any other)
+_SYMMETRIC_CALLS = COLLECTIVE_CALLS | KNOWN_EMITTING_CALLS | frozenset(
     {
         "verify_health_words",
         "header_cat_lengths",
@@ -283,7 +310,7 @@ def check_function(
 
     def emits(node: ast.Call) -> bool:
         name = _call_name(node.func)
-        if name in COLLECTIVE_CALLS:
+        if name in COLLECTIVE_CALLS or name in KNOWN_EMITTING_CALLS:
             return True
         return name in fns and fns[name].emits and name != info.name
 
@@ -394,7 +421,11 @@ def run_schedule_pass(tree: ast.Module, path: str) -> List[Finding]:
     fns = _module_functions(tree)
     findings: List[Finding] = []
     for info in fns.values():
-        if not (info.emits_direct or any(c in fns and fns[c].emits for c in info.calls)):
+        if not (
+            info.emits_direct
+            or any(c in fns and fns[c].emits for c in info.calls)
+            or any(c in KNOWN_EMITTING_CALLS for c in info.calls)
+        ):
             continue
         findings.extend(check_function(fns, info, path))
     return findings

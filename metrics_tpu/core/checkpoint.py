@@ -261,6 +261,18 @@ def _fx_tag(fx: Any) -> Optional[str]:
 
 
 def _metric_record(m: Metric, writer: _PayloadWriter) -> Dict[str, Any]:
+    if m.__dict__.get("_inflight") is not None or m.__dict__.get("_inflight_collection") is not None:
+        # refuse rather than drain: the live state holds only the
+        # post-snapshot DELTA while a non-blocking round owns the
+        # accumulation, and an implicit drain here would silently serialize
+        # a collective stall into the checkpoint cadence. The caller decides:
+        # resolve (compute()/sync()) or cancel (unsync()) first.
+        raise MetricsTPUUserError(
+            f"save_checkpoint: {type(m).__name__} has a non-blocking sync round "
+            "in flight — the live state holds only the post-snapshot delta. "
+            "Resolve the round (compute()/sync()) or cancel it (unsync()) "
+            "before snapshotting."
+        )
     if m._is_synced:
         raise MetricsTPUUserError(
             f"save_checkpoint: {type(m).__name__} is currently synced. Snapshots "
@@ -1031,6 +1043,17 @@ class MetricCheckpointer:
     def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> None:
         self.metric._auto_checkpointer = None
         if exc_type is None and self._pending and not self._state_traced():
+            if self._inflight_round():
+                from metrics_tpu.utils.prints import rank_zero_warn
+
+                rank_zero_warn(
+                    "checkpointer exiting with a non-blocking sync round in "
+                    "flight — the tail snapshot was skipped (the live state "
+                    "holds only the post-snapshot delta). Resolve or cancel "
+                    "the round, then call snapshot() for a final checkpoint.",
+                    RuntimeWarning,
+                )
+                return
             self.snapshot()  # flush the tail on a clean exit
 
     def _state_traced(self) -> bool:
@@ -1041,6 +1064,22 @@ class MetricCheckpointer:
         )
         return any(is_traced(leaf) for leaf in jax.tree_util.tree_leaves(state_tree))
 
+    def _inflight_round(self) -> bool:
+        metrics = (
+            list(self.metric.values())
+            if isinstance(self.metric, MetricCollection)
+            else [self.metric]
+        )
+        if isinstance(self.metric, MetricCollection) and (
+            self.metric.__dict__.get("_inflight_round") is not None
+        ):
+            return True
+        return any(
+            m.__dict__.get("_inflight") is not None
+            or m.__dict__.get("_inflight_collection") is not None
+            for m in metrics
+        )
+
     def after_update(self, metric: Union[Metric, MetricCollection]) -> None:
         """Hook called by the stateful ``update``/``forward`` paths."""
         self._pending += 1
@@ -1048,6 +1087,12 @@ class MetricCheckpointer:
             return  # cheap counter bump — no per-step tree walk off the due cycle
         if self._state_traced():
             return  # tracing compiles the step; snapshot at the next eager update
+        if self._inflight_round():
+            # a non-blocking sync round owns the accumulation (live state is
+            # the post-snapshot delta) and save_checkpoint would refuse it;
+            # defer — the pending counter stays due, so the first eligible
+            # update after the round resolves snapshots immediately
+            return
         self.snapshot()
 
     def snapshot(self) -> str:

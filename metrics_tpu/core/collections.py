@@ -40,13 +40,20 @@ from metrics_tpu.core.compiled import (
 from metrics_tpu.core.metric import (
     _ComputeGroup,
     _ON_ERROR_MODES,
+    _SYNC_MODES,
     Metric,
     _copy_state_value,
     _raise_on_catbuffer_overflow,
 )
+from metrics_tpu.parallel.async_sync import (
+    drain_round,
+    launch_round,
+    new_sync_stats,
+    resolve_round,
+)
 from metrics_tpu.parallel.health import FUSED_KEY_SEP as _FUSED_KEY_SEP
 from metrics_tpu.utils.data import is_traced
-from metrics_tpu.utils.exceptions import MetricsTPUUserError, SyncError
+from metrics_tpu.utils.exceptions import MetricsTPUUserError, StaleSyncError, SyncError
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
@@ -192,6 +199,19 @@ class MetricCollection(dict):
             explicitly; ``False`` disables grouping.
     """
 
+    #: Collection-level analogue of :attr:`Metric.sync_mode`: ``"overlap"``
+    #: makes ``compute()`` resolve ONE collection-level background round
+    #: (launched a compute-interval earlier over the combined bucketed
+    #: payload) and launch the next, so the whole collection's periodic
+    #: ``compute()`` costs ~0 host wall-clock. Plain attribute
+    #: (``mc.sync_mode = "overlap"``) or the ``sync_mode=`` ctor kwarg.
+    sync_mode: str = "blocking"
+
+    #: What a stale collection-round resolve serves — one policy for the
+    #: whole round (all-or-nothing application); see
+    #: :attr:`Metric.staleness_policy`.
+    staleness_policy: str = "snapshot"
+
     def __init__(
         self,
         metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
@@ -199,10 +219,25 @@ class MetricCollection(dict):
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: Union[bool, Sequence[Sequence[str]]] = True,
+        sync_mode: str = "blocking",
+        staleness_policy: str = "snapshot",
     ) -> None:
         super().__init__()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
+        if sync_mode not in _SYNC_MODES:
+            raise MetricsTPUUserError(
+                f"`sync_mode` must be one of {_SYNC_MODES}, got {sync_mode!r}"
+            )
+        self.sync_mode = sync_mode
+        from metrics_tpu.parallel.async_sync import validate_staleness_policy
+
+        self.staleness_policy = validate_staleness_policy(staleness_policy)
+        self._inflight_round = None
+        self._inflight_owners: Optional[List[Tuple[str, Metric, List[Metric]]]] = None
+        self._inflight_counts: Optional[Dict[str, int]] = None
+        self._sync_epoch = 0
+        self._overlap_warned = False
         if not (
             isinstance(compute_groups, bool)
             or (
@@ -1012,9 +1047,32 @@ class MetricCollection(dict):
         return values
 
     def compute(self) -> Dict[str, Any]:
+        """Compute every member's value.
+
+        With a collection-level overlapped round in flight — or
+        ``sync_mode="overlap"`` set — this resolves/launches through ONE
+        collection sync (members then compute on the applied views with
+        zero per-member collectives) and restores the local accumulations
+        on the way out; otherwise each member syncs itself as before.
+        """
+        overlap_auto = getattr(self, "sync_mode", "blocking") == "overlap"
+        if self.__dict__.get("_inflight_round") is not None or (
+            overlap_auto and self._overlap_eligible(None)
+        ):
+            self.sync()
+            try:
+                return {self._set_name(k): m.compute() for k, m in super().items()}
+            finally:
+                self.unsync()
         return {self._set_name(k): m.compute() for k, m in super().items()}
 
     def reset(self) -> None:
+        round_, _owners, _counts = self._clear_inflight()
+        if round_ is not None:
+            # the accumulation is being discarded, but the round's
+            # collectives were launched on every rank: drain symmetrically
+            drain_round(round_)
+            self._sync_stats_dict()["cancelled"] += 1
         groups = list(self._iter_group_objects())
         for g in groups:
             g.dispatching = True
@@ -1029,7 +1087,18 @@ class MetricCollection(dict):
         # so members that had copy-on-write detached can rejoin their group
         self._groups_stale = True
 
+    def __getstate__(self) -> Dict[str, Any]:
+        # consulted by BOTH pickle and copy.deepcopy (via __reduce_ex__):
+        # an in-flight round's future holds thread locks and cannot be
+        # serialized or copied — drain it symmetrically first (fold-back
+        # preserves every member's accumulation)
+        self._cancel_overlap()
+        return self.__dict__
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        # an in-flight round's future cannot deepcopy: drain symmetrically
+        # first (fold-back preserves every member's accumulation)
+        self._cancel_overlap()
         mc = deepcopy(self)
         if prefix:
             mc.prefix = self._check_arg(prefix, "prefix")
@@ -1116,6 +1185,7 @@ class MetricCollection(dict):
         distributed_available: Optional[Callable] = None,
         on_error: Optional[str] = None,
         timeout: Optional[float] = None,
+        blocking: Optional[bool] = None,
     ) -> None:
         """Host-sync every member, threading the fault-tolerance knobs.
 
@@ -1152,12 +1222,63 @@ class MetricCollection(dict):
           state (``Metric.sync`` swallows the error per member); a degraded
           group keeps its shared views intact (state is untouched) and every
           sibling is marked degraded together.
+
+        ``blocking=False`` launches ONE collection-level **non-blocking**
+        round instead (``parallel/async_sync.py``): the combined
+        (group-deduped, key-prefixed) states snapshot into the round, every
+        member restarts on fresh delta buffers, and the fused header +
+        bucketed payload gather on a background thread. The next
+        ``sync()``/``compute()``/``state_dict()`` resolves the round and
+        applies it to every member all-or-nothing (a mid-application
+        failure mutates nothing); :attr:`sync_mode` ``"overlap"`` pipelines
+        this automatically. A failed resolve degrades exactly like a failed
+        blocking fused sync: all-``"raise"`` raises after every member's
+        full local accumulation is restored, otherwise the per-member
+        *blocking* loop reruns so each member degrades (or recovers)
+        independently.
         """
         if on_error is not None and on_error not in _ON_ERROR_MODES:
             raise MetricsTPUUserError(
                 f"`on_error` must be one of {_ON_ERROR_MODES}, got {on_error!r}"
             )
         self._ensure_groups()
+        overlap_auto = getattr(self, "sync_mode", "blocking") == "overlap"
+        if blocking is None:
+            blocking = not overlap_auto
+        failed_resolve = False
+        if should_sync and self.__dict__.get("_inflight_round") is not None:
+            try:
+                self._resolve_overlap(
+                    on_error=on_error, timeout=timeout, relaunch=not blocking
+                )
+                return
+            except SyncError:
+                modes = [
+                    on_error if on_error is not None else getattr(m, "sync_on_error", "raise")
+                    for m in self.values()
+                ]
+                if all(mode == "raise" for mode in modes):
+                    raise  # every member's local accumulation was restored first
+                # degradation requested somewhere: every member holds its
+                # restored local state — rerun the per-member BLOCKING loop
+                # so each applies its own on_error (and a healthy channel
+                # lets healthy members recover with a fresh gather)
+                failed_resolve = True
+                blocking = True
+        if should_sync and not blocking and dist_sync_fn is None:
+            if self._overlap_eligible(distributed_available):
+                self._launch_overlap(timeout=timeout, serve_local=overlap_auto)
+                return
+            if not self.__dict__.get("_overlap_warned", False):
+                self._overlap_warned = True
+                rank_zero_warn(
+                    "MetricCollection cannot overlap its sync (a member has a "
+                    "custom dist_sync_fn/process_group, non-mergeable state, "
+                    "strict update counts, or the fused path is disabled) — "
+                    "falling back to the blocking path.",
+                    UserWarning,
+                )
+            blocking = True
         if should_sync and dist_sync_fn is None and self._fused_sync_eligible(distributed_available):
             try:
                 self._sync_fused(timeout=timeout)
@@ -1187,6 +1308,7 @@ class MetricCollection(dict):
                     distributed_available=distributed_available,
                     on_error=on_error,
                     timeout=timeout,
+                    blocking=blocking,
                 )
                 if m._is_synced:
                     synced.append(m)
@@ -1194,6 +1316,11 @@ class MetricCollection(dict):
             for m in synced:
                 m.unsync()
             raise
+        if failed_resolve and any(m._sync_degraded for m in self.values()):
+            # count the round degraded only when a member actually ended on
+            # local-only state — a blocking rerun that fully recovered every
+            # member is a recovery, not a degradation
+            self._sync_stats_dict()["degraded"] += 1
 
     def _fused_sync_eligible(self, distributed_available: Optional[Callable]) -> bool:
         """Can this collection sync through one combined bucketed plan?
@@ -1213,6 +1340,10 @@ class MetricCollection(dict):
             m.dist_sync_fn is not None
             or m.process_group is not None
             or m._is_synced
+            # a member-level overlapped round owns that member's
+            # accumulation: a fused gather of its live (delta) state would
+            # move the wrong bytes — the per-member loop resolves it instead
+            or m.__dict__.get("_inflight") is not None
             or getattr(m, "sync_fused", None) is False
             # strict update-count checking is per member: the combined
             # header carries one summed count column, which would escalate
@@ -1234,6 +1365,31 @@ class MetricCollection(dict):
             if not avail():
                 return False
         return True
+
+    def _combined_payload(
+        self,
+        owners: List[Tuple[str, Metric, List[Metric]]],
+        state_of: Callable[[Metric], Dict[str, Any]],
+    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """The key-prefixed combined state + reductions the fused paths
+        (blocking ``_sync_fused`` AND overlapped rounds) gather — one
+        definition, so the two transports can never disagree on payload
+        schema."""
+        combined: Dict[str, Any] = {}
+        reductions: Dict[str, Any] = {}
+        for key, m, _peers in owners:
+            for name, value in state_of(m).items():
+                combined[f"{key}{_FUSED_KEY_SEP}{name}"] = value
+                reductions[f"{key}{_FUSED_KEY_SEP}{name}"] = m._reductions.get(name)
+        return combined, reductions
+
+    def _effective_member_timeout(self, timeout: Optional[float]) -> Optional[float]:
+        member_timeouts = [
+            t for m in self.values() if (t := getattr(m, "sync_timeout", None)) is not None
+        ]
+        return timeout if timeout is not None else (
+            min(member_timeouts) if member_timeouts else None
+        )
 
     def _sync_state_owners(self) -> List[Tuple[str, Metric, List[Metric]]]:
         """One ``(key, metric, group_siblings)`` triple per *unique* state:
@@ -1265,23 +1421,12 @@ class MetricCollection(dict):
         from metrics_tpu.parallel.sync import host_sync_state
 
         owners = self._sync_state_owners()
-        combined: Dict[str, Any] = {}
-        reductions: Dict[str, Any] = {}
-        for key, m, _peers in owners:
-            for name, value in m._state.items():
-                combined[f"{key}{_FUSED_KEY_SEP}{name}"] = value
-                reductions[f"{key}{_FUSED_KEY_SEP}{name}"] = m._reductions.get(name)
-        member_timeouts = [
-            t for m in self.values() if (t := getattr(m, "sync_timeout", None)) is not None
-        ]
-        effective_timeout = timeout if timeout is not None else (
-            min(member_timeouts) if member_timeouts else None
-        )
+        combined, reductions = self._combined_payload(owners, lambda m: m._state)
         synced = host_sync_state(
             combined,
             reductions,
             update_count=sum(getattr(m, "_update_count", 0) for _, m, _p in owners),
-            timeout=effective_timeout,
+            timeout=self._effective_member_timeout(timeout),
             metric_name=f"MetricCollection[{', '.join(self.keys())}]",
             fused=True,
         )
@@ -1304,6 +1449,263 @@ class MetricCollection(dict):
                     p._state[name] = m._state[name]
                 p._is_synced = True
 
+    # ---------------- overlapped (non-blocking) collection sync ----------------
+
+    def _sync_stats_dict(self) -> Dict[str, Any]:
+        stats = self.__dict__.get("_sync_stats")
+        if stats is None:
+            stats = new_sync_stats()
+            self.__dict__["_sync_stats"] = stats
+        return stats
+
+    def sync_stats(self) -> Dict[str, Any]:
+        """Overlapped-sync observability, mirroring :meth:`compile_stats`:
+        the ``collection`` entry counts collection-level rounds (one round =
+        one fused header + bucketed payload for ALL members), member entries
+        count their own standalone rounds. See :meth:`Metric.sync_stats`."""
+        stats = self.__dict__.get("_sync_stats")
+        coll = dict(new_sync_stats() if stats is None else stats)
+        return {"collection": coll, "members": {k: m.sync_stats() for k, m in super().items()}}
+
+    def _overlap_eligible(self, distributed_available: Optional[Callable]) -> bool:
+        """Can this collection launch one combined non-blocking round? The
+        fused-path conditions plus: every member's state must merge
+        algebraically (the post-snapshot delta folds back via
+        ``merge_states``) and no round may already be in flight."""
+        if self.__dict__.get("_inflight_round") is not None:
+            return False
+        if not self._fused_sync_eligible(distributed_available):
+            return False
+        return all(m._overlap_refusal() is None for m in self.values())
+
+    def _launch_combined(
+        self,
+        owners: List[Tuple[str, Metric, List[Metric]]],
+        state_of: Callable[[Metric], Dict[str, Any]],
+        timeout: Optional[float],
+    ) -> None:
+        """The one launch path for a collection round: build the combined
+        key-prefixed payload from ``state_of(owner)`` (live state on a fresh
+        launch, the unsync cache on a pipeline relaunch), launch, and record
+        the in-flight bookkeeping."""
+        combined, reductions = self._combined_payload(owners, state_of)
+        counts = {key: getattr(m, "_update_count", 0) for key, m, _peers in owners}
+        self._sync_epoch = self.__dict__.get("_sync_epoch", 0) + 1
+        round_ = launch_round(
+            combined,
+            reductions,
+            update_count=sum(counts.values()),
+            epoch=self._sync_epoch,
+            metric_name=f"MetricCollection[{', '.join(self.keys())}]",
+            timeout=self._effective_member_timeout(timeout),
+            fused=True,
+        )
+        self._inflight_round = round_
+        self._inflight_owners = owners
+        self._inflight_counts = counts
+        for m in self.values():
+            object.__setattr__(m, "_inflight_collection", self)
+        self._sync_stats_dict()["launched"] += 1
+
+    def _launch_overlap(self, timeout: Optional[float] = None, serve_local: bool = False) -> None:
+        """Launch ONE background round over the combined (group-deduped,
+        key-prefixed) member states and restart every member on fresh delta
+        buffers — the collection-level double buffer. ``serve_local`` (the
+        ``sync_mode="overlap"`` pipeline's first interval) serves each
+        member its just-snapshotted accumulation as this read's value."""
+        owners = self._sync_state_owners()
+        snapshots = {key: dict(m._state) for key, m, _peers in owners}  # move
+        self._launch_combined(owners, lambda m: m._state, timeout)
+        # the round owns the snapshot containers; members restart on fresh
+        # defaults (group siblings re-link onto ONE fresh state)
+        for _key, m, _peers in owners:
+            m._restore(m._default_state())
+        self._relink_groups()
+        if serve_local:
+            for key, m, peers in owners:
+                # cache the fresh DELTA buffers before repointing the owner
+                # at the snapshot — every member's unsync must restore the
+                # delta side of the double buffer, never the snapshot
+                fresh = {k: _copy_state_value(v) for k, v in m._state.items()}
+                for x in [m] + peers:
+                    x._cache = {k: _copy_state_value(v) for k, v in fresh.items()}
+                    x._sync_degraded = False
+                    object.__setattr__(x, "_donation_ready", False)
+                    for name in x._state:
+                        x._state[name] = snapshots[key][name]
+                    x._is_synced = True
+            self._sync_stats_dict()["served_local"] += 1
+
+    def _clear_inflight(self):
+        round_ = self.__dict__.get("_inflight_round")
+        owners = self.__dict__.get("_inflight_owners")
+        counts = self.__dict__.get("_inflight_counts")
+        self._inflight_round = None
+        self._inflight_owners = None
+        self._inflight_counts = None
+        for m in self.values():
+            object.__setattr__(m, "_inflight_collection", None)
+        return round_, owners, counts
+
+    def _inflight_members(self, owners) -> List[Tuple[str, Metric, List[Metric]]]:
+        """The launch-time owner map, split for members that copy-on-write
+        detached from their group mid-flight: a detached member keeps its
+        own delta and resolves against the same snapshot slice."""
+        out: List[Tuple[str, Metric, List[Metric]]] = []
+        for key, m, peers in owners:
+            grouped = [
+                p
+                for p in peers
+                if p._compute_group is not None and p._compute_group is m._compute_group
+            ]
+            out.append((key, m, grouped))
+            for p in peers:
+                if p not in grouped:
+                    out.append((key, p, []))
+        return out
+
+    def _fold_back_overlap(self, combined_snapshot, owners, counts) -> None:
+        """Restore every member's full local accumulation (its launch
+        snapshot slice merged with its delta) — the before-any-raise step of
+        every collection-round failure path."""
+        for key, x, _grouped in self._inflight_members(owners):
+            snapshot = {
+                name: combined_snapshot[f"{key}{_FUSED_KEY_SEP}{name}"]
+                for name in x._state
+            }
+            if getattr(x, "_update_count", 0) > counts[key]:
+                delta = {k: _copy_state_value(v) for k, v in x._state.items()}
+                x._restore(x.merge_states(snapshot, delta))
+            else:
+                x._restore(snapshot)
+            x._cache = None
+            g = x._compute_group
+            if g is not None:
+                self._relink_group(g, x)
+
+    def _resolve_overlap(
+        self,
+        on_error: Optional[str] = None,
+        timeout: Optional[float] = None,
+        relaunch: bool = False,
+    ) -> None:
+        """Consume the collection's in-flight round and apply it to every
+        member **all-or-nothing**: every member's policy view and restored
+        local accumulation are computed first, then committed — a failure
+        anywhere (the background task's typed error, or a
+        ``staleness_policy="fresh"`` stale member) restores every member's
+        full local accumulation and raises; the caller
+        (:meth:`sync`) runs the degradation ladder. ``relaunch`` pipelines
+        the next round from the restored accumulations."""
+        round_, owners, counts = self._clear_inflight()
+        stats = self._sync_stats_dict()
+        try:
+            synced, wait_s = resolve_round(round_, timeout=timeout)
+        except SyncError:
+            self._fold_back_overlap(round_.snapshot, owners, counts)
+            raise
+        stats["resolved"] += 1
+        stats["gather_s"] += round_.gather_s
+        stats["resolve_wait_s"] += wait_s
+        stats["overlap_saved_s"] += max(0.0, round_.gather_s - wait_s)
+        policy = getattr(self, "staleness_policy", "snapshot")
+        members = self._inflight_members(owners)
+        any_stale = any(
+            getattr(x, "_update_count", 0) > counts[key] for key, x, _g in members
+        )
+        if any_stale:
+            stats["stale_resolves"] += 1
+            if policy == "fresh":
+                self._fold_back_overlap(round_.snapshot, owners, counts)
+                raise StaleSyncError(
+                    f"overlapped sync round {round_.epoch} of this "
+                    "MetricCollection resolved stale: update() ran after the "
+                    "snapshot was taken (staleness_policy='fresh'). Resolve "
+                    "before updating, or accept bounded staleness with "
+                    "staleness_policy='snapshot'|'merge'."
+                )
+        # ---- all-or-nothing: compute every member's (view, local) first ----
+        plans: List[Tuple[Metric, List[Metric], Dict[str, Any], Dict[str, Any]]] = []
+        for key, x, grouped in members:
+            snapshot = {
+                name: round_.snapshot[f"{key}{_FUSED_KEY_SEP}{name}"] for name in x._state
+            }
+            gathered = {name: synced[f"{key}{_FUSED_KEY_SEP}{name}"] for name in x._state}
+            if getattr(x, "_update_count", 0) > counts[key]:
+                delta = {k: _copy_state_value(v) for k, v in x._state.items()}
+                local = x.merge_states(snapshot, delta)
+                view = x.merge_states(gathered, delta) if policy == "merge" else gathered
+            else:
+                local, view = snapshot, gathered
+            plans.append((x, grouped, view, local))
+        # ---- commit ----
+        for x, grouped, view, local in plans:
+            x._cache = local
+            x._sync_degraded = False
+            x._restore(view)
+            x._is_synced = True
+            for p in grouped:
+                p._cache = {k: _copy_state_value(v) for k, v in local.items()}
+                p._sync_degraded = False
+                object.__setattr__(p, "_donation_ready", False)
+                for name in x._state:
+                    p._state[name] = x._state[name]
+                p._is_synced = True
+        if relaunch:
+            # pipeline: hand every member's restored accumulation (their
+            # unsync caches) to the next round, leaving fresh delta buffers
+            # for the paired unsync
+            self._relaunch_from_caches(timeout)
+
+    def _relaunch_from_caches(self, timeout: Optional[float]) -> None:
+        """Pipeline relaunch: hand every member's restored accumulation (its
+        unsync cache) to the next round, leaving fresh delta buffers for the
+        paired unsync to restore."""
+        owners = self._sync_state_owners()
+        self._launch_combined(owners, lambda m: m._cache or m._state, timeout)
+        for _key, m, peers in owners:
+            fresh = m._default_state()
+            m._cache = fresh
+            for p in peers:
+                p._cache = {k: _copy_state_value(v) for k, v in fresh.items()}
+
+    def _resolve_member_request(
+        self, member: Metric, on_error: Optional[str] = None, timeout: Optional[float] = None
+    ) -> None:
+        """A single member's read (``compute()``/``sync()``/``state_dict()``)
+        while a COLLECTION round covers its state: the whole round resolves
+        (one future, all members applied all-or-nothing) and every member is
+        left synced — restore them together with the collection's
+        :meth:`unsync`. The requesting member's own sync context then
+        unsyncs just that member, exactly as its blocking compute would."""
+        self.sync(on_error=on_error, timeout=timeout, blocking=True)
+
+    def _cancel_overlap(self) -> None:
+        """The symmetric cancel for a collection round (``unsync()`` /
+        ``reset()`` / ``clone()`` mid-flight): drain the round on every rank
+        — never un-queue — discard the result or its error identically, and
+        fold every member's snapshot slice back (see
+        :meth:`Metric._cancel_overlap`)."""
+        round_, owners, counts = self._clear_inflight()
+        if round_ is None:
+            return
+        drain_round(round_)
+        self._sync_stats_dict()["cancelled"] += 1
+        if any(m._is_synced for m in self.values()):
+            # mid-pipeline: the drained round owns the accumulations; the
+            # members are serving the previous resolve — repoint their
+            # unsync caches at the snapshot slices (updates were refused
+            # while synced, so the delta caches are empty)
+            for key, m, peers in owners:
+                snap = {
+                    name: round_.snapshot[f"{key}{_FUSED_KEY_SEP}{name}"]
+                    for name in m._state
+                }
+                for x in [m] + peers:
+                    x._cache = {k: _copy_state_value(v) for k, v in snap.items()}
+            return
+        self._fold_back_overlap(round_.snapshot, owners, counts)
+
     def unsync(self, should_unsync: bool = True) -> None:
         """Restore every synced member's pre-sync local state.
 
@@ -1311,8 +1713,16 @@ class MetricCollection(dict):
         were never marked synced and are skipped rather than raising.
         Compute-group views are re-linked afterwards (each member restored
         an equal-valued copy; re-aliasing keeps the one-copy-of-state
-        invariant)."""
+        invariant). A collection-level overlapped round that was launched
+        but never resolved is **cancelled symmetrically** here: drained to
+        completion on every rank, its result discarded, and every member's
+        snapshot slice folded back (see :meth:`Metric._cancel_overlap`)."""
         if not should_unsync:
+            return
+        if self.__dict__.get("_inflight_round") is not None and not any(
+            m._is_synced for m in self.values()
+        ):
+            self._cancel_overlap()
             return
         for m in self.values():
             if m._is_synced:
@@ -1328,6 +1738,7 @@ class MetricCollection(dict):
         distributed_available: Optional[Callable] = None,
         on_error: Optional[str] = None,
         timeout: Optional[float] = None,
+        blocking: Optional[bool] = None,
     ) -> Iterator["MetricCollection"]:
         """Collection-wide sync-on-enter / restore-on-exit (the consistent-
         checkpoint pattern), with ``on_error`` graceful degradation."""
@@ -1337,6 +1748,7 @@ class MetricCollection(dict):
             distributed_available=distributed_available,
             on_error=on_error,
             timeout=timeout,
+            blocking=blocking,
         )
         try:
             yield self

@@ -52,6 +52,14 @@ from metrics_tpu.core.compiled import (
     rebuild_call,
     split_call,
 )
+from metrics_tpu.parallel.async_sync import (
+    AsyncSyncRound,
+    drain_round,
+    launch_round,
+    new_sync_stats,
+    resolve_round,
+    validate_staleness_policy,
+)
 from metrics_tpu.parallel.health import NONFINITE_STATE
 from metrics_tpu.parallel.sync import (
     host_sync_state,
@@ -62,6 +70,7 @@ from metrics_tpu.utils.data import apply_to_collection, is_traced
 from metrics_tpu.utils.exceptions import (
     MetricsTPUUserError,
     NonFiniteStateError,
+    StaleSyncError,
     StateDictMismatchError,
     StateSchemaError,
     SyncError,
@@ -70,6 +79,12 @@ from metrics_tpu.utils.prints import rank_zero_warn
 
 #: Accepted ``on_error`` / ``sync_on_error`` degradation modes.
 _ON_ERROR_MODES = ("raise", "local", "warn")
+
+#: Accepted ``sync_mode`` values: ``"blocking"`` gathers inline at
+#: ``sync()``/``compute()``; ``"overlap"`` double-buffers — the gather rides
+#: a background thread while the training step keeps updating, and the next
+#: read resolves it (``parallel/async_sync.py``).
+_SYNC_MODES = ("blocking", "overlap")
 
 _MERGEABLE_FX = ("sum", "cat", "max", "min")
 
@@ -382,6 +397,15 @@ class Metric:
         sync_timeout: watchdog timeout (seconds) for this metric's host
             collectives; ``None`` uses the ``METRICS_TPU_SYNC_TIMEOUT_S``
             env knob (default 600), ``0`` disables the watchdog.
+        sync_mode: ``"blocking"`` (default) or ``"overlap"`` — see the
+            :attr:`sync_mode` attribute. Overlap mode double-buffers the
+            host sync: ``compute()`` resolves a gather launched one
+            interval earlier on a background thread and launches the next,
+            so the collective cost hides behind the training step
+            (``docs/performance.md``; requires mergeable state).
+        staleness_policy: ``"snapshot"`` (default), ``"merge"`` or
+            ``"fresh"`` — what a resolved overlapped round means when
+            updates ran mid-flight (see :attr:`staleness_policy`).
         compiled_update: per-metric override of the compiled eager hot path
             (see the :attr:`compiled_update` attribute): ``None`` follows
             the ``METRICS_TPU_COMPILED_UPDATE`` env knob, ``False`` keeps
@@ -438,6 +462,45 @@ class Metric:
     #: compute-group siblings, a user-held reference, or the pre-sync cache.
     _donation_ready: bool = False
 
+    #: Default sync strategy for the automatic sync in ``compute()`` and for
+    #: ``sync()`` calls that don't pass ``blocking=``: ``"blocking"`` stalls
+    #: on the gather inline; ``"overlap"`` pipelines it — each ``compute()``
+    #: resolves the previous round (launched one interval earlier on a
+    #: background thread, so the collective cost is hidden behind the
+    #: training step) and launches the next. The first overlap-mode
+    #: ``compute()`` has no round to resolve and serves the local-only
+    #: accumulation (counted in :meth:`sync_stats` as ``served_local``).
+    #: Plain attribute so it can be flipped on any constructed metric.
+    sync_mode: str = "blocking"
+
+    #: What a resolved overlapped round means when ``update()`` ran between
+    #: launch and resolve (the resolve is then *stale by construction*):
+    #: ``"snapshot"`` (default) serves the consistent world state at the
+    #: snapshot cut — identical on every rank; ``"merge"`` folds this rank's
+    #: post-snapshot delta in via ``merge_states`` — fresher, but the served
+    #: value becomes rank-dependent; ``"fresh"`` refuses with a typed
+    #: :class:`~metrics_tpu.utils.exceptions.StaleSyncError` (degradable via
+    #: ``on_error``). Never silently mixed: stale resolves are counted in
+    #: :meth:`sync_stats` under every policy.
+    staleness_policy: str = "snapshot"
+
+    #: The in-flight overlapped sync round (``parallel/async_sync.py``), or
+    #: ``None``. At most one per metric; launched by ``sync(blocking=False)``
+    #: / the ``sync_mode="overlap"`` pipeline, consumed by the next
+    #: ``compute()``/``sync()``/``state_dict()`` (or drained by ``unsync()``
+    #: / ``reset()``).
+    _inflight: Optional[AsyncSyncRound] = None
+
+    #: The owning ``MetricCollection`` while a COLLECTION-level overlapped
+    #: round covers this metric's state: member-level reads delegate their
+    #: resolve to it (one round, all-or-nothing application).
+    _inflight_collection: Optional[Any] = None
+
+    #: Monotonic overlapped-round counter; rides the health word's
+    #: ``sync_epoch`` column so every rank verifies it launches/resolves the
+    #: SAME round (protocol v3).
+    _sync_epoch: int = 0
+
     #: Compute-group link (set by ``MetricCollection`` when this metric is
     #: grouped with schema/update-identical siblings; ``None`` = ungrouped).
     _compute_group: Optional[_ComputeGroup] = None
@@ -465,6 +528,8 @@ class Metric:
         sync_on_error: str = "raise",
         sync_timeout: Optional[float] = None,
         compiled_update: Optional[bool] = None,
+        sync_mode: str = "blocking",
+        staleness_policy: str = "snapshot",
     ) -> None:
         # bypass custom __setattr__ while bootstrapping
         object.__setattr__(self, "_state", {})
@@ -483,6 +548,12 @@ class Metric:
             )
         self.sync_on_error = sync_on_error
         self.sync_timeout = sync_timeout
+        if sync_mode not in _SYNC_MODES:
+            raise MetricsTPUUserError(
+                f"`sync_mode` must be one of {_SYNC_MODES}, got {sync_mode!r}"
+            )
+        self.sync_mode = sync_mode
+        self.staleness_policy = validate_staleness_policy(staleness_policy)
         # overridable seam for integrations/tests: sync() fires only when this
         # reports a world (reference gates on torch.distributed initialization,
         # metric.py:274-277; here the default is multi-process JAX)
@@ -857,7 +928,14 @@ class Metric:
         the fault-tolerance knobs thread into :func:`host_sync_state`."""
         fn = self.dist_sync_fn if fn is None else fn
         if fn is not None:
-            return fn(state, self._reductions)
+            # the ordering guard applies to custom transports too: a
+            # foreground sync must drain launched background rounds before
+            # issuing its own collectives (the custom path has no epoch
+            # header to catch a mispairing after the fact)
+            from metrics_tpu.parallel.async_sync import sync_channel
+
+            with sync_channel():
+                return fn(state, self._reductions)
         return host_sync_state(
             state,
             self._reductions,
@@ -875,6 +953,7 @@ class Metric:
         distributed_available: Optional[Callable] = None,
         on_error: Optional[str] = None,
         timeout: Optional[float] = None,
+        blocking: Optional[bool] = None,
     ) -> None:
         """Synchronize state across processes (host path); caches local state.
 
@@ -892,6 +971,20 @@ class Metric:
 
         ``on_error``/``timeout`` default to the constructor's
         ``sync_on_error``/``sync_timeout``.
+
+        ``blocking=False`` launches a **non-blocking, double-buffered**
+        round instead (``parallel/async_sync.py``): the current
+        accumulation is snapshotted, the health-word gather plus the
+        bucketed payload run on a background thread, and this call returns
+        immediately with the metric *not* synced — the training loop keeps
+        calling ``update()`` (into fresh delta buffers) while the
+        collective rides behind it. The next ``compute()``/``sync()``/
+        ``state_dict()`` resolves the in-flight round; :attr:`sync_mode`
+        ``"overlap"`` makes this the default for every automatic sync and
+        pipelines resolve-then-relaunch, and :attr:`staleness_policy`
+        decides what a resolve that observed post-snapshot updates serves.
+        A ``sync()`` (any blocking value) while a round is in flight
+        resolves that round rather than issuing a competing gather.
         """
         if self._is_synced and should_sync:
             raise MetricsTPUUserError("The Metric has already been synced.")
@@ -900,6 +993,25 @@ class Metric:
             raise MetricsTPUUserError(
                 f"`on_error` must be one of {_ON_ERROR_MODES}, got {on_error!r}"
             )
+        overlap_default = getattr(self, "sync_mode", "blocking") == "overlap"
+        if blocking is None:
+            blocking = not overlap_default
+        # an in-flight round resolves regardless of the CURRENT distributed
+        # predicate: it was launched when a world existed, and consuming it
+        # touches no new collective — only the round's future
+        if should_sync:
+            owner = self.__dict__.get("_inflight_collection")
+            if owner is not None:
+                owner._resolve_member_request(self, on_error=on_error, timeout=timeout)
+                return
+            if self.__dict__.get("_inflight") is not None:
+                self._resolve_overlap(
+                    on_error=on_error,
+                    timeout=timeout,
+                    relaunch=not blocking,
+                    dist_sync_fn=dist_sync_fn,
+                )
+                return
         is_distributed = (
             distributed_available() if distributed_available is not None else self.distributed_available_fn()
         )
@@ -914,50 +1026,80 @@ class Metric:
                 "`pure_sync` over mesh axes; the host sync path always spans "
                 "all processes. Drop `process_group` or inject `dist_sync_fn`."
             )
+        if not blocking:
+            # overlap_default (sync_mode="overlap") means this launch came
+            # from the automatic pipeline: the caller is about to read, so
+            # serve the local accumulation for this first interval
+            self._launch_overlap(
+                dist_sync_fn=dist_sync_fn, timeout=timeout, serve_local=overlap_default
+            )
+            return
         self._cache = {k: _copy_state_value(v) for k, v in self._state.items()}
         self._sync_degraded = False
         try:
             synced = self._run_dist_sync(self._cache, timeout=timeout, fn=fn)
         except SyncError as err:
-            self._cache = None
-            if on_error == "raise":
-                raise
-            # swallowed: mark the degradation so a paired unsync() is a
-            # tolerated no-op instead of an "already un-synced" crash
-            self._sync_degraded = True
-            if isinstance(err, NonFiniteStateError) and self._local_state_poisoned():
-                # degradation promises a degraded-but-CORRECT local result;
-                # when this rank's own state is the poisoned one, its local
-                # values are garbage — say so instead of implying they are
-                # merely partial (every rank warns: rank-zero gating could
-                # hide the corruption on a non-zero rank)
-                warnings.warn(
-                    f"Cross-process sync of {type(self).__name__} failed "
-                    f"({type(err).__name__}: {err}) — falling back to LOCAL-ONLY "
-                    "state, and THIS process's own state is NaN/Inf-poisoned: "
-                    "reported values are CORRUPT, not merely partial.",
-                    RuntimeWarning,
-                    stacklevel=2,
-                )
-                return
-            msg = (
-                f"Cross-process sync of {type(self).__name__} failed "
-                f"({type(err).__name__}: {err}) — falling back to LOCAL-ONLY "
-                "state; reported values cover this process's data only."
-            )
-            if on_error == "warn":
-                warnings.warn(msg, RuntimeWarning, stacklevel=2)
-            else:
-                rank_zero_warn(msg, RuntimeWarning)
+            self._handle_sync_failure(err, on_error)
             return
         self._restore(synced)
         self._is_synced = True
 
+    def _handle_sync_failure(self, err: SyncError, on_error: str) -> None:
+        """The shared ``on_error`` ladder for a failed sync — a blocking
+        gather or a resolved overlapped round, degradation identical either
+        way. The caller has already restored (or never touched) the full
+        local accumulation; this clears the sync cache, re-raises under
+        ``"raise"``, and otherwise marks the degradation (so a paired
+        ``unsync()`` is a tolerated no-op) and warns."""
+        self._cache = None
+        if on_error == "raise":
+            raise err
+        # swallowed: mark the degradation so a paired unsync() is a
+        # tolerated no-op instead of an "already un-synced" crash
+        self._sync_degraded = True
+        if isinstance(err, NonFiniteStateError) and self._local_state_poisoned():
+            # degradation promises a degraded-but-CORRECT local result;
+            # when this rank's own state is the poisoned one, its local
+            # values are garbage — say so instead of implying they are
+            # merely partial (every rank warns: rank-zero gating could
+            # hide the corruption on a non-zero rank)
+            warnings.warn(
+                f"Cross-process sync of {type(self).__name__} failed "
+                f"({type(err).__name__}: {err}) — falling back to LOCAL-ONLY "
+                "state, and THIS process's own state is NaN/Inf-poisoned: "
+                "reported values are CORRUPT, not merely partial.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            return
+        msg = (
+            f"Cross-process sync of {type(self).__name__} failed "
+            f"({type(err).__name__}: {err}) — falling back to LOCAL-ONLY "
+            "state; reported values cover this process's data only."
+        )
+        if on_error == "warn":
+            warnings.warn(msg, RuntimeWarning, stacklevel=2)
+        else:
+            rank_zero_warn(msg, RuntimeWarning)
+
     def unsync(self, should_unsync: bool = True) -> None:
-        """Restore the pre-sync local state (reference ``metric.py:289-309``)."""
+        """Restore the pre-sync local state (reference ``metric.py:289-309``).
+
+        Called while a non-blocking round is in flight (launched but not
+        yet resolved), this is the **symmetric cancel**: the round is
+        drained to completion on every rank — never un-queued, which could
+        strand a peer mid-rendezvous — its result is discarded, and the
+        snapshot folds back into the live accumulation, so no data is lost
+        and no future leaks. Mid-pipeline (a resolved round currently
+        *served*, with the next one already launched), the ordinary restore
+        runs and the new round simply stays in flight for the next read.
+        """
         if not should_unsync:
             return
         if not self._is_synced:
+            if self.__dict__.get("_inflight") is not None:
+                self._cancel_overlap()
+                return
             if self._sync_degraded:
                 # the paired sync degraded under on_error="local"/"warn" and
                 # kept the local state — the documented sync → state_dict →
@@ -993,14 +1135,16 @@ class Metric:
         distributed_available: Optional[Callable] = None,
         on_error: Optional[str] = None,
         timeout: Optional[float] = None,
+        blocking: Optional[bool] = None,
     ) -> "Metric._SyncContext":
         """Context manager: sync on enter, restore local state on exit.
 
         Analogue of reference ``metric.py:311-343``; the documented pattern for
         consistent checkpoints (sync → state_dict → unsync). ``on_error`` /
-        ``timeout`` thread to :meth:`sync`; with ``on_error="local"`` a
-        failed sync leaves the metric un-synced on its local state (the
-        context body still runs, and exit skips the unsync).
+        ``timeout`` / ``blocking`` thread to :meth:`sync`; with
+        ``on_error="local"`` a failed sync leaves the metric un-synced on
+        its local state (the context body still runs, and exit skips the
+        unsync).
         """
         return Metric._SyncContext(
             self,
@@ -1010,7 +1154,228 @@ class Metric:
             distributed_available=distributed_available,
             on_error=on_error,
             timeout=timeout,
+            blocking=blocking,
         )
+
+    # ------------------------------------------------------------------
+    # overlapped (non-blocking, double-buffered) sync
+    # ------------------------------------------------------------------
+
+    def _sync_stats_dict(self) -> Dict[str, Any]:
+        stats = self.__dict__.get("_sync_stats")
+        if stats is None:
+            stats = new_sync_stats()
+            object.__setattr__(self, "_sync_stats", stats)
+        return stats
+
+    def sync_stats(self) -> Dict[str, Any]:
+        """Observability for the overlapped sync path (mirrors
+        :meth:`compile_stats` for the compiled hot path): rounds
+        ``launched``/``resolved``/``cancelled``, ``stale_resolves``
+        (post-snapshot updates observed at resolve), ``degraded``
+        (``on_error`` fallbacks), ``served_local`` (overlap-mode computes
+        with no resolved round yet), and the wall-clock ledger —
+        ``gather_s`` (background collective time), ``resolve_wait_s`` (how
+        long resolves actually blocked) and ``overlap_saved_s`` (their
+        difference: the collective cost hidden behind the training step,
+        i.e. what the same syncs would have stalled in blocking mode).
+        """
+        stats = self.__dict__.get("_sync_stats")
+        return dict(new_sync_stats() if stats is None else stats)
+
+    def _overlap_refusal(self) -> Optional[str]:
+        """Why this metric cannot overlap its sync (``None`` = it can)."""
+        if not self._can_merge():
+            return (
+                "its state has no algebraic merge, so the post-snapshot "
+                "delta could never be folded back (override `merge_states` "
+                "or use mergeable reductions; blocking sync only)"
+            )
+        if self.dist_sync_on_step:
+            return (
+                "dist_sync_on_step syncs the transient batch state inside "
+                "every forward(), which cannot compose with an in-flight "
+                "accumulation round (the resolve would apply the gathered "
+                "accumulation over a batch state)"
+            )
+        return None
+
+    def _launch_overlap(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        timeout: Optional[float] = None,
+        serve_local: bool = False,
+    ) -> None:
+        """Snapshot the accumulation, launch the background gather, return.
+
+        Double-buffer move: the round takes ownership of the live state
+        containers (host gathers never mutate their inputs) and the live
+        side restarts from fresh defaults — the delta buffer the training
+        loop keeps updating. The restore clears ``_donation_ready``, so the
+        compiled hot path's next dispatch copies before donating and can
+        never invalidate the snapshot mid-gather. ``serve_local`` (the
+        ``sync_mode="overlap"`` pipeline's first interval) additionally
+        serves the just-snapshotted accumulation as this read's value:
+        state aliases the snapshot read-only, the fresh delta buffers ride
+        the unsync cache.
+        """
+        reason = self._overlap_refusal()
+        if reason is not None:
+            raise MetricsTPUUserError(
+                f"non-blocking sync of {type(self).__name__} refused: {reason}."
+            )
+        self._group_detach_if_stray()
+        snapshot = dict(self._state)  # move container ownership to the round
+        self._restore(self._default_state())
+        self._launch_overlap_from(snapshot, dist_sync_fn, timeout)
+        if serve_local:
+            round_ = self.__dict__["_inflight"]
+            self._cache = {k: _copy_state_value(v) for k, v in self._state.items()}
+            self._sync_degraded = False
+            object.__setattr__(self, "_donation_ready", False)
+            for name, v in round_.snapshot.items():
+                self._state[name] = v
+            self._is_synced = True
+            self._sync_stats_dict()["served_local"] += 1
+
+    def _launch_overlap_from(
+        self,
+        snapshot: Dict[str, Any],
+        dist_sync_fn: Optional[Callable],
+        timeout: Optional[float],
+    ) -> None:
+        """Launch one round over ``snapshot`` (ownership transferred)."""
+        object.__setattr__(self, "_sync_epoch", getattr(self, "_sync_epoch", 0) + 1)
+        fn = dist_sync_fn or self.dist_sync_fn
+        sync_fn = None
+        if fn is not None:
+            reductions = self._reductions
+            sync_fn = lambda: fn(snapshot, reductions)  # noqa: E731
+        round_ = launch_round(
+            snapshot,
+            self._reductions,
+            update_count=getattr(self, "_update_count", 0),
+            epoch=self._sync_epoch,
+            metric_name=type(self).__name__,
+            strict_update_count=self.sync_strict_update_count,
+            timeout=timeout if timeout is not None else getattr(self, "sync_timeout", None),
+            fused=getattr(self, "sync_fused", None),
+            sync_fn=sync_fn,
+        )
+        object.__setattr__(self, "_inflight", round_)
+        self._sync_stats_dict()["launched"] += 1
+
+    def _fold_back_round(self, round_: AsyncSyncRound, stale: bool) -> None:
+        """Restore the full local accumulation — the round's snapshot merged
+        with whatever delta accumulated since launch — into the live state.
+        Every failure/cancel path runs this before raising or degrading, so
+        an overlapped round can never lose data."""
+        if stale:
+            delta = {k: _copy_state_value(v) for k, v in self._state.items()}
+            self._restore(self.merge_states(round_.snapshot, delta))
+        else:
+            self._restore(round_.snapshot)
+        self._cache = None
+
+    def _resolve_overlap(
+        self,
+        on_error: Optional[str] = None,
+        timeout: Optional[float] = None,
+        relaunch: bool = False,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        """Consume the in-flight round: wait for the gathered result (≈0
+        when the collectives already finished behind the step), verify
+        staleness against the snapshot's update count, apply the
+        :attr:`staleness_policy`, and leave the metric synced exactly as a
+        blocking :meth:`sync` would. Failures — the background task's typed
+        ``SyncError`` (watchdog timeouts and poisoned/divergent headers
+        included) or a ``"fresh"``-policy stale resolve — first restore the
+        full local accumulation, then run the ordinary ``on_error`` ladder.
+        ``relaunch`` (the ``sync_mode="overlap"`` pipeline) hands the
+        restored local accumulation straight to the next round.
+        """
+        on_error = getattr(self, "sync_on_error", "raise") if on_error is None else on_error
+        round_ = self.__dict__["_inflight"]
+        object.__setattr__(self, "_inflight", None)
+        stats = self._sync_stats_dict()
+        stale = getattr(self, "_update_count", 0) > round_.update_count
+        try:
+            synced, wait_s = resolve_round(
+                round_,
+                timeout=timeout if timeout is not None else getattr(self, "sync_timeout", None),
+            )
+        except SyncError as err:
+            self._fold_back_round(round_, stale)
+            self._handle_sync_failure(err, on_error)  # raises under "raise"
+            stats["degraded"] += 1
+            return
+        stats["resolved"] += 1
+        stats["gather_s"] += round_.gather_s
+        stats["resolve_wait_s"] += wait_s
+        stats["overlap_saved_s"] += max(0.0, round_.gather_s - wait_s)
+        policy = getattr(self, "staleness_policy", "snapshot")
+        if stale:
+            stats["stale_resolves"] += 1
+            if policy == "fresh":
+                self._fold_back_round(round_, stale)
+                self._handle_sync_failure(
+                    StaleSyncError(
+                        f"overlapped sync round {round_.epoch} of "
+                        f"{type(self).__name__} resolved stale: "
+                        f"{getattr(self, '_update_count', 0) - round_.update_count} "
+                        "update() call(s) ran after the snapshot was taken "
+                        "(staleness_policy='fresh'). Resolve before updating, or "
+                        "accept bounded staleness with "
+                        "staleness_policy='snapshot'|'merge'."
+                    ),
+                    on_error,
+                )
+                stats["degraded"] += 1
+                return
+            delta = {k: _copy_state_value(v) for k, v in self._state.items()}
+            local = self.merge_states(round_.snapshot, delta)
+            view = self.merge_states(synced, delta) if policy == "merge" else synced
+        else:
+            local = round_.snapshot
+            view = synced
+        self._cache = local  # solely owned: the round is consumed
+        self._sync_degraded = False
+        self._restore(view)
+        self._is_synced = True
+        if relaunch:
+            # pipeline: the unsync cache holds the full local accumulation —
+            # hand it to the next round and leave fresh delta buffers for
+            # the paired unsync to restore
+            next_snapshot = self._cache
+            self._cache = self._default_state()
+            self._launch_overlap_from(next_snapshot, dist_sync_fn, timeout)
+
+    def _cancel_overlap(self) -> None:
+        """The symmetric cancel (``unsync()``/``reset()``/copy paths while a
+        round is in flight): drain the round to completion on every rank —
+        ``future.cancel()`` is never attempted, because whether a queued
+        task can still be un-queued differs per rank and an un-queued rank
+        would strand its peers mid-rendezvous — discard the result or its
+        error identically, and fold the snapshot back so the live
+        accumulation is exactly what it would have been without the launch.
+        """
+        round_ = self.__dict__.get("_inflight")
+        if round_ is None:
+            return
+        object.__setattr__(self, "_inflight", None)
+        drain_round(round_, timeout=getattr(self, "sync_timeout", None))
+        self._sync_stats_dict()["cancelled"] += 1
+        if self._is_synced:
+            # mid-pipeline (a resolved round is being served while the next
+            # was already launched): the drained round owns the local
+            # accumulation — repoint the unsync cache at it (updates are
+            # refused while synced, so the delta cache it replaces is empty)
+            self._cache = {k: _copy_state_value(v) for k, v in round_.snapshot.items()}
+        else:
+            self._fold_back_round(
+                round_, getattr(self, "_update_count", 0) > round_.update_count
+            )
 
     # ------------------------------------------------------------------
     # pure-functional API (jit / shard_map)
@@ -1467,6 +1832,20 @@ class Metric:
 
     def reset(self) -> None:
         """Reset state to defaults (reference ``metric.py:381-398``)."""
+        owner = self.__dict__.get("_inflight_collection")
+        if owner is not None:
+            # a COLLECTION round owns this member's accumulation: cancel it
+            # (symmetric drain + fold-back for every member) first, or the
+            # round's resolve would resurrect the pre-reset accumulation
+            owner._cancel_overlap()
+        round_ = self.__dict__.get("_inflight")
+        if round_ is not None:
+            # the accumulation is being discarded anyway, but the round's
+            # collectives were launched at this program point on every rank:
+            # drain symmetrically (never un-queue) before dropping it
+            object.__setattr__(self, "_inflight", None)
+            drain_round(round_, timeout=getattr(self, "sync_timeout", None))
+            self._sync_stats_dict()["cancelled"] += 1
         self._group_detach_if_stray()
         self._update_called = False
         self._update_count = 0
@@ -1480,11 +1859,27 @@ class Metric:
         """Deep copy (reference ``metric.py:400``)."""
         return deepcopy(self)
 
+    def _drain_rounds_for_copy(self) -> None:
+        """Before a copy/serialization: drain whatever round owns this
+        metric's accumulation — the member-level one, or the COLLECTION
+        round covering it (whose snapshot holds the accumulated state; a
+        copy taken without the fold-back would capture only the delta)."""
+        owner = self.__dict__.get("_inflight_collection")
+        if owner is not None:
+            owner._cancel_overlap()
+        self._cancel_overlap()
+
     def __deepcopy__(self, memo: dict) -> "Metric":
+        # an in-flight round holds an unpicklable, un-copyable future whose
+        # collectives are already running: drain it symmetrically (the copy
+        # and the original both resume from the folded-back accumulation)
+        self._drain_rounds_for_copy()
         cls = self.__class__
         new = cls.__new__(cls)
         memo[id(self)] = new
         for k, v in self.__dict__.items():
+            if k == "_inflight_collection":
+                v = None  # never drag the owning collection into a clone
             object.__setattr__(new, k, deepcopy(v, memo))
         # deepcopy may hand immutable array leaves back by reference, so the
         # clone and the original can share state buffers — neither may donate
@@ -1504,7 +1899,19 @@ class Metric:
             self._persistent[name] = mode
 
     def state_dict(self, prefix: str = "") -> Dict[str, Any]:
-        """Host-side snapshot of persistent states (numpy leaves)."""
+        """Host-side snapshot of persistent states (numpy leaves).
+
+        While a non-blocking sync round is in flight, the round is resolved
+        first (the documented "next read" contract): the snapshot then
+        captures the synced view, exactly as the blocking
+        sync → state_dict → unsync pattern would — pair with ``unsync()``
+        to return to the local accumulation.
+        """
+        owner = self.__dict__.get("_inflight_collection")
+        if owner is not None:
+            owner._resolve_member_request(self)
+        if self.__dict__.get("_inflight") is not None and not self._is_synced:
+            self._resolve_overlap()
         # np.asarray of a CPU-backed jax array can be a zero-copy view; the
         # snapshot must survive a later donating dispatch, so force a copy
         # at the next compiled update instead of risking the view's buffer.
@@ -1723,7 +2130,14 @@ class Metric:
 
     # pickling: jnp arrays pickle via numpy
     def __getstate__(self) -> Dict[str, Any]:
-        state = {k: v for k, v in self.__dict__.items() if k != "update" and k != "compute"}
+        # a future cannot pickle: drain any in-flight round symmetrically
+        # (fold-back preserves the accumulation) before serializing
+        self._drain_rounds_for_copy()
+        state = {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("update", "compute", "_inflight_collection")
+        }
         state["_state"] = apply_to_collection(self._state, (jnp.ndarray,), np.asarray)
         state["_defaults"] = apply_to_collection(self._defaults, (jnp.ndarray,), np.asarray)
         state["_cache"] = apply_to_collection(self._cache, (jnp.ndarray,), np.asarray)

@@ -1,3 +1,11 @@
+from metrics_tpu.parallel.async_sync import (
+    STALENESS_POLICIES,
+    AsyncSyncRound,
+    drain_round,
+    launch_round,
+    resolve_round,
+    sync_channel,
+)
 from metrics_tpu.parallel.bucketing import (
     SyncPlan,
     build_sync_plan,

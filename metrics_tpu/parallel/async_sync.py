@@ -1,0 +1,435 @@
+"""Non-blocking, double-buffered host sync — hide the collective behind the step.
+
+``sync()``/``compute()`` block the host on the health-word gather plus the
+bucketed payload, so a periodic ``compute()`` stalls every rank for the full
+DCN round-trip. The fix is the one fine-grained compute/communication
+overlap applies to training collectives (PAPERS.md "T3: Transparent Tracking
+& Triggering for Fine-grained Overlap of Compute & Collectives"): launch the
+gather early, keep computing, consume the result when it lands. Quantized
+collectives (PAPERS.md "EQuARX") only *shrink* that stall; overlap *hides*
+it.
+
+This module is the transport layer of that mode (the ``Metric`` /
+``MetricCollection`` wiring lives in ``core/metric.py`` /
+``core/collections.py``; knobs: ``sync(blocking=False)``,
+``sync_mode="overlap"``, ``staleness_policy``):
+
+- **Double buffering.** A launch *moves* the live accumulation into an
+  :class:`AsyncSyncRound` snapshot and resets the live state to fresh
+  defaults — the snapshot buffer rides the background collectives while the
+  live buffer keeps accumulating post-snapshot deltas. Nothing aliases both
+  sides, so the training step (including the compiled hot path, whose
+  ``_donation_ready`` latch is cleared at launch exactly as for any other
+  restore) never races the gather.
+- **One background lane, deterministic order.** All rounds run on a single
+  dedicated executor thread in launch order. Host collectives have no
+  hardware stream ordering, so cross-thread interleaving is excluded
+  structurally: every foreground ``host_sync_state`` enters
+  :func:`sync_channel`, which *drains* rounds already launched (launch
+  points are SPMD program order, identical on every rank) before issuing
+  its own gathers — the global collective order is a deterministic
+  function of program order on every rank.
+- **Epoch negotiation.** Each round carries a monotonically increasing
+  ``sync_epoch`` in the health word (protocol v3): the header verifies the
+  column equal across ranks, so a rank resolving background round N can
+  never pair with a peer's foreground sync (epoch 0) or a different round —
+  the mispairing raises a typed ``StateDivergenceError`` on every rank.
+- **Staleness is reported, never mixed.** A resolve that observes
+  post-snapshot updates is *stale by construction*. The
+  :data:`STALENESS_POLICIES` (wired through ``Metric.staleness_policy``)
+  decide what the resolved value means: ``"snapshot"`` (default) serves the
+  consistent world state at the snapshot cut — identical on every rank;
+  ``"merge"`` folds this rank's post-snapshot delta in via ``merge_states``
+  — fresher, but rank-local deltas make the served value rank-dependent;
+  ``"fresh"`` demands a non-stale resolve and raises a typed
+  :class:`~metrics_tpu.utils.exceptions.StaleSyncError` otherwise
+  (degradable via ``on_error="local"`` like any sync failure).
+- **Failure degrades exactly like blocking.** The background round runs the
+  full health-checked ``host_sync_state`` — watchdog included, and a fired
+  watchdog latches the process-wide channel-suspect flag from the
+  background thread too. The typed error surfaces at resolve, where the
+  ``on_error`` ladder applies unchanged; the full local accumulation
+  (snapshot ⊕ delta) is restored before anything raises, so degradation
+  never loses data.
+- **Cancel = drain.** ``future.cancel()`` is never used: a round's
+  collectives were launched at the same program point on every rank, so a
+  rank that un-queues its task while a peer's already started would strand
+  the peer mid-rendezvous. The only deterministic cancel is to wait the
+  round out and discard the result identically everywhere
+  (:func:`drain_round` — the ``unsync()``-mid-flight path).
+
+The bucketed plans (``parallel/bucketing.py``) are reused across overlapped
+rounds unchanged: the plan cache is lock-protected and keyed on the schema
+string, and a round's snapshot has the same schema as the blocking path
+would sync, so repeated rounds hit the cached plan from the background
+thread without re-planning.
+"""
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeoutError
+from concurrent.futures import wait as _futures_wait
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, Optional
+
+from metrics_tpu.utils.exceptions import SyncTimeoutError
+
+__all__ = [
+    "STALENESS_POLICIES",
+    "AsyncSyncRound",
+    "drain_round",
+    "launch_round",
+    "new_sync_stats",
+    "resolve_round",
+    "sync_channel",
+    "validate_staleness_policy",
+]
+
+#: Accepted ``staleness_policy`` values (see module docstring).
+STALENESS_POLICIES = ("fresh", "snapshot", "merge")
+
+
+def validate_staleness_policy(policy: str) -> str:
+    if policy not in STALENESS_POLICIES:
+        from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+        raise MetricsTPUUserError(
+            f"`staleness_policy` must be one of {STALENESS_POLICIES}, got {policy!r}"
+        )
+    return policy
+
+
+def new_sync_stats() -> Dict[str, Any]:
+    """A fresh ``sync_stats()`` counter block (shared shape for Metric and
+    MetricCollection — mirrors ``compile_stats()``'s role for the compiled
+    hot path):
+
+    - ``launched`` / ``resolved`` — overlapped rounds started / consumed;
+    - ``stale_resolves`` — resolves that observed post-snapshot updates
+      (served per the staleness policy, or raised under ``"fresh"``);
+    - ``degraded`` — resolves that fell back to local-only state under
+      ``on_error="local"``/``"warn"``;
+    - ``cancelled`` — rounds drained and discarded (``unsync()`` mid-flight);
+    - ``served_local`` — overlap-mode computes served from local state
+      because no round had been resolved yet (the pipeline's first interval);
+    - ``gather_s`` — total background wall-clock the collectives took;
+    - ``resolve_wait_s`` — total wall-clock resolves actually blocked;
+    - ``overlap_saved_s`` — ``gather_s − resolve_wait_s`` accumulated per
+      round: the collective time hidden behind the training step, i.e. what
+      the same syncs would have stalled the host in blocking mode.
+    """
+    return {
+        "launched": 0,
+        "resolved": 0,
+        "stale_resolves": 0,
+        "degraded": 0,
+        "cancelled": 0,
+        "served_local": 0,
+        "gather_s": 0.0,
+        "resolve_wait_s": 0.0,
+        "overlap_saved_s": 0.0,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the background lane: one executor thread, channel-ordering guard
+# ---------------------------------------------------------------------------
+
+class SerialExecutor:
+    """One daemon worker executing submitted tasks strictly in order.
+
+    Deliberately NOT ``concurrent.futures.ThreadPoolExecutor``: its workers
+    are non-daemon and joined at interpreter exit, so a single round stuck
+    on a dead peer would hang process shutdown — exactly the forever-block
+    the sync watchdog exists to prevent. The daemon worker dies with the
+    process instead (the same policy as the watchdog's abandoned workers),
+    while the strict submission order preserves the deterministic
+    cross-rank collective schedule. ``initializer`` runs once on the worker
+    before any task (simulated-world harnesses use it to adopt a rank's
+    thread-local identity).
+    """
+
+    def __init__(self, name: str, initializer: Optional[Callable[[], None]] = None) -> None:
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._initializer = initializer
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        if self._initializer is not None:
+            self._initializer()
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            fn, future = item
+            if not future.set_running_or_notify_cancel():
+                continue
+            try:
+                future.set_result(fn())
+            except BaseException as err:  # noqa: BLE001 - delivered via the future
+                future.set_exception(err)
+
+    def submit(self, fn: Callable[[], Any]) -> Future:
+        future: Future = Future()
+        self._queue.put((fn, future))
+        return future
+
+    def shutdown(self, wait: bool = False) -> None:
+        self._queue.put(None)
+        if wait:
+            self._thread.join()
+
+
+_EXECUTOR: Optional[SerialExecutor] = None
+_EXECUTOR_LOCK = threading.Lock()
+
+_PENDING_LOCK = threading.Lock()
+_PENDING: Dict[Any, Any] = {}  # future -> launch domain
+
+#: Thread-local marker: set while the executor thread runs a round's task,
+#: so :func:`sync_channel` skips the drain (a round waiting on itself would
+#: deadlock) and only takes the lock.
+_IN_ROUND = threading.local()
+
+
+def _current_domain() -> Any:
+    """Identity of the launching "process". In production every rank IS its
+    own process, so this module's pending-round set is per-rank by
+    construction and one constant domain suffices. Simulated multi-rank
+    worlds (thread-per-rank harnesses like ``tests/helpers/fake_world.py``)
+    share this module across fake ranks and monkeypatch this to the current
+    thread's rank identity, so a rank's foreground sync drains only ITS OWN
+    launched rounds — waiting on a *peer's* round would deadlock the very
+    rendezvous (the peer's round needs this rank's collectives to finish),
+    and is not something a real multi-process rank could ever do."""
+    return None
+
+
+def _get_executor() -> SerialExecutor:
+    """The dedicated single-worker executor (the seam tests monkeypatch to
+    give each simulated rank its own lane with the rank's thread-local
+    identity — see ``tests/helpers/fake_world.py``). One worker is a
+    correctness property, not a tuning default: rounds must execute in
+    launch order for the cross-rank collective schedule to be deterministic.
+    """
+    global _EXECUTOR
+    with _EXECUTOR_LOCK:
+        if _EXECUTOR is None:
+            _EXECUTOR = SerialExecutor("metrics-tpu-async-sync")
+        return _EXECUTOR
+
+
+def _drain_pending(timeout: Optional[float] = None) -> None:
+    """Wait until every round THIS process launched has finished its
+    collectives (their results — values or typed errors — stay in the
+    futures for their resolvers). Bounded by the watchdog timeout: a round
+    that cannot finish means a stuck collective, so the channel is marked
+    suspect and the
+    caller gets the same typed :class:`SyncTimeoutError` a blocking sync
+    would."""
+    domain = _current_domain()
+    with _PENDING_LOCK:
+        pending = [f for f, d in _PENDING.items() if d == domain]
+    if not pending:
+        return
+    from metrics_tpu.parallel.health import get_sync_timeout, mark_channel_suspect
+
+    limit = get_sync_timeout(timeout)
+    _done, not_done = _futures_wait(pending, timeout=limit if limit > 0 else None)
+    if not_done:
+        mark_channel_suspect()
+        raise SyncTimeoutError(
+            f"{len(not_done)} in-flight overlapped sync round(s) did not "
+            f"complete within {limit:g}s — a peer process is likely dead or "
+            "stalled mid-round. Raise METRICS_TPU_SYNC_TIMEOUT_S for slow "
+            "interconnects, or recover with on_error='local'."
+        )
+
+
+@contextmanager
+def sync_channel() -> Iterator[None]:
+    """Order one host-sync after the background lane's launched rounds.
+
+    Foreground callers (``host_sync_state`` on the user's thread) first
+    drain every round already launched: launch points are SPMD program
+    order, so after the drain every rank has executed the identical prefix
+    of collectives, and the foreground gather that follows pairs with its
+    peers' — never with a straggling background round. The executor thread
+    skips the drain (it IS the pending work). No lock is held across the
+    gather itself: rounds serialize on the single executor worker, user
+    syncs run on the user's (single) thread after draining, and launching
+    requires that same thread — so the two lanes can never actually
+    interleave collectives. (Issuing host syncs from several user threads
+    concurrently was never supported, in blocking mode or this one.)
+    """
+    if not getattr(_IN_ROUND, "active", False):
+        _drain_pending()
+    yield
+
+
+# ---------------------------------------------------------------------------
+# rounds: launch / resolve / drain
+# ---------------------------------------------------------------------------
+
+
+class AsyncSyncRound:
+    """One in-flight non-blocking sync round.
+
+    Owns the state snapshot the collectives gather (moved out of the live
+    metric at launch — the live side accumulates deltas into fresh buffers),
+    the launch-time bookkeeping staleness detection needs
+    (``update_count``), the negotiated ``epoch``, and the future holding the
+    gathered result or its typed error. ``gather_s`` is filled by the task
+    when the collectives finish (background wall-clock).
+    """
+
+    __slots__ = (
+        "snapshot",
+        "reductions",
+        "update_count",
+        "epoch",
+        "metric_name",
+        "future",
+        "gather_s",
+        "launched_monotonic",
+    )
+
+    def __init__(
+        self,
+        snapshot: Dict[str, Any],
+        reductions: Dict[str, Any],
+        *,
+        update_count: int,
+        epoch: int,
+        metric_name: str,
+    ) -> None:
+        self.snapshot = snapshot
+        self.reductions = reductions
+        self.update_count = int(update_count)
+        self.epoch = int(epoch)
+        self.metric_name = metric_name
+        self.future: Any = None
+        self.gather_s: float = 0.0
+        self.launched_monotonic = time.monotonic()
+
+
+def launch_round(
+    snapshot: Dict[str, Any],
+    reductions: Dict[str, Any],
+    *,
+    update_count: int,
+    epoch: int,
+    metric_name: str = "metric",
+    strict_update_count: bool = False,
+    timeout: Optional[float] = None,
+    fused: Optional[bool] = None,
+    sync_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+) -> AsyncSyncRound:
+    """Launch the health-checked host sync of ``snapshot`` on the background
+    lane and return immediately.
+
+    The returned round's future resolves to the synced state dict, or to the
+    same typed ``SyncError`` the blocking path would raise — verification,
+    watchdog and channel-suspect behavior are literally the blocking code
+    running on another thread. ``sync_fn`` overrides the transport (a custom
+    ``dist_sync_fn``); the default is
+    :func:`~metrics_tpu.parallel.sync.host_sync_state` with this round's
+    ``sync_epoch`` riding the header.
+    """
+    round_ = AsyncSyncRound(
+        snapshot,
+        reductions,
+        update_count=update_count,
+        epoch=epoch,
+        metric_name=metric_name,
+    )
+
+    def task() -> Dict[str, Any]:
+        from metrics_tpu.parallel.sync import host_sync_state
+
+        _IN_ROUND.active = True
+        start = time.monotonic()
+        try:
+            if sync_fn is not None:
+                with sync_channel():
+                    return sync_fn()
+            return host_sync_state(
+                round_.snapshot,
+                round_.reductions,
+                update_count=round_.update_count,
+                strict_update_count=strict_update_count,
+                timeout=timeout,
+                metric_name=round_.metric_name,
+                fused=fused,
+                sync_epoch=round_.epoch,
+            )
+        finally:
+            round_.gather_s = time.monotonic() - start
+            _IN_ROUND.active = False
+
+    domain = _current_domain()
+    future = _get_executor().submit(task)
+    round_.future = future
+    with _PENDING_LOCK:
+        _PENDING[future] = domain
+    future.add_done_callback(_discard_pending)
+    return round_
+
+
+def _discard_pending(future: Any) -> None:
+    with _PENDING_LOCK:
+        _PENDING.pop(future, None)
+
+
+def resolve_round(round_: AsyncSyncRound, timeout: Optional[float] = None):
+    """Block until the round's gathered result is available.
+
+    Returns ``(synced_state, wait_s)`` where ``wait_s`` is how long this
+    call actually blocked (≈0 when the gather finished behind the step —
+    the whole point). Re-raises the background task's typed ``SyncError``
+    unchanged; a future that cannot complete within the watchdog bound
+    marks the channel suspect and raises :class:`SyncTimeoutError`, exactly
+    like a blocking gather stuck on a dead peer.
+    """
+    from metrics_tpu.parallel.health import get_sync_timeout, mark_channel_suspect
+
+    limit = get_sync_timeout(timeout)
+    start = time.monotonic()
+    try:
+        # generous outer bound: the inner watchdog (inside host_sync_state)
+        # fires first on a dead peer; this guards the executor lane itself
+        synced = round_.future.result(timeout=2 * limit if limit > 0 else None)
+    except _FutureTimeoutError:
+        mark_channel_suspect()
+        raise SyncTimeoutError(
+            f"overlapped sync round {round_.epoch} of {round_.metric_name} did "
+            f"not resolve within {2 * limit:g}s — a peer process is likely dead "
+            "or stalled mid-round. Recover with on_error='local' or restart "
+            "the process group."
+        ) from None
+    return synced, time.monotonic() - start
+
+
+def drain_round(round_: AsyncSyncRound, timeout: Optional[float] = None) -> None:
+    """The symmetric cancel: wait the round out and discard its result.
+
+    ``future.cancel()`` is deliberately never attempted — whether a queued
+    task can still be un-queued differs per rank (a peer's may already be
+    inside the rendezvous), so cancellation by un-queueing would strand
+    peers mid-collective. Every rank instead drains the round to completion
+    and discards the gathered value *or its error* identically; the
+    snapshot the caller folds back into the live state is untouched either
+    way. Even a round stuck past the watchdog bound is handled the same —
+    the result (here: nothing) is discarded, and the channel-suspect latch
+    :func:`resolve_round` set on the way out makes the NEXT sync refuse
+    loudly, so the liveness failure still surfaces without making the
+    cancel path's outcome depend on per-rank timing.
+    """
+    try:
+        resolve_round(round_, timeout=timeout)
+    except Exception:
+        # the round's typed error is discarded with its result: every rank
+        # sees the same future outcome, so every rank discards together
+        return None

@@ -38,7 +38,12 @@ cached keyed on the exact schema string behind the health word's CRC
 (:func:`~metrics_tpu.parallel.health.state_schema_parts` — the full string,
 so a CRC collision can never alias two schemas onto one plan), so repeated
 ``compute()`` calls pay zero re-planning. Per-rank row counts — the only
-dynamic input — ride the header gather's length columns.
+dynamic input — ride the header gather's length columns. The cache is
+lock-protected and plans are immutable after construction, so the async
+overlap layer (``parallel/async_sync.py``) reuses them from its background
+thread across overlapped rounds — a round's snapshot has the same schema
+the blocking path would sync, so rounds hit the cached plan without
+re-planning.
 
 Execution requires the caller to have *already verified* the gathered
 health words: the plan trusts cross-rank schema equality (verified via the

@@ -12,6 +12,7 @@ payload gather, every rank contributes one small int32 *health word* per
 metric in a *single* ``process_allgather``::
 
     [version, schema_hash, update_count, overflow, nonfinite, n_states,
+     sync_epoch,
      count_0 ... count_{COUNT_SLOTS-1},
      len_0 ... len_{CAT_LENGTH_SLOTS-1}]
 
@@ -25,6 +26,15 @@ metric in a *single* ``process_allgather``::
 - ``nonfinite``     the ``check_finite`` poison verdict: the latched flag OR
                     an exact state scan (0 when screening is off);
 - ``n_states``      number of declared states (poison flag included);
+- ``sync_epoch``    which synchronization round this gather belongs to:
+                    ``0`` for every blocking sync, the metric's monotonically
+                    increasing overlapped-round number for a non-blocking
+                    (``parallel/async_sync.py``) round. Negotiated
+                    symmetrically: all ranks must contribute the same epoch,
+                    so a rank that launched overlapped round N while a peer
+                    is still blocking (or already on round N+1) raises a
+                    typed ``StateDivergenceError`` on every rank instead of
+                    pairing a background gather with a foreground one;
 - ``count_j``       participation count of the j-th state (sorted by name):
                     CatBuffer fill count, number of appended batches for
                     list states (a rank that appended one zero-row batch
@@ -106,6 +116,7 @@ __all__ = [
     "get_sync_timeout",
     "distributed_initialize_with_retry",
     "channel_is_suspect",
+    "mark_channel_suspect",
     "reset_channel_health",
 ]
 
@@ -113,8 +124,10 @@ T = TypeVar("T")
 
 #: v2: CAT_LENGTH_SLOTS per-leaf row-length columns appended to the word so
 #: the bucketed planner can size ragged payload buffers with zero extra
-#: shape gathers. v1 peers are caught by the width/version checks.
-HEALTH_PROTOCOL_VERSION = 2
+#: shape gathers. v3: the ``sync_epoch`` column (overlapped-round alignment
+#: for ``parallel/async_sync.py``). v1/v2 peers are caught by the
+#: width/version checks.
+HEALTH_PROTOCOL_VERSION = 3
 
 #: Reserved state name for the ``check_finite`` poison flag (see
 #: ``Metric.enable_check_finite``): an int32 scalar with ``dist_reduce_fx="sum"``
@@ -137,7 +150,8 @@ _F_UPDATES = 2
 _F_OVERFLOW = 3
 _F_NONFINITE = 4
 _F_NSTATES = 5
-_F_FIXED = 6
+_F_EPOCH = 6
+_F_FIXED = 7
 
 #: Fixed number of per-state count slots; unused slots hold the -1 sentinel.
 COUNT_SLOTS = 16
@@ -361,7 +375,10 @@ def state_poisoned(state: Dict[str, Any]) -> bool:
 
 
 def build_health_word(
-    state: Dict[str, Any], reductions: Dict[str, Any], update_count: int = 0
+    state: Dict[str, Any],
+    reductions: Dict[str, Any],
+    update_count: int = 0,
+    sync_epoch: int = 0,
 ) -> np.ndarray:
     """This rank's int32 health word for one metric's state dict.
 
@@ -403,6 +420,7 @@ def build_health_word(
         overflow,
         nonfinite,
         len(names),
+        int(sync_epoch),
     ] + slots + length_slots
     return np.asarray(word, dtype=np.int32)
 
@@ -441,6 +459,20 @@ def verify_health_words(
             f"sync-header protocol version skew for {metric_name}: "
             f"{sorted(set(versions.tolist()))} — ranks are running different "
             "metrics_tpu versions. All processes raised."
+        )
+
+    # 0a) sync-round (epoch) skew: a rank resolving overlapped round N while
+    #     a peer contributes a blocking sync (epoch 0) or a different round
+    #     would pair a background gather with a foreground one — the exact
+    #     cross-thread mispairing the overlap protocol must exclude
+    epochs = words[:, _F_EPOCH]
+    if not (epochs == epochs[0]).all():
+        raise StateDivergenceError(
+            f"sync-round skew for {metric_name}: per-rank sync epochs "
+            f"{epochs.tolist()} differ — ranks disagree whether (or which) "
+            "overlapped sync round this collective belongs to. Launch "
+            "non-blocking syncs at the same step on every rank. All "
+            "processes raised together."
         )
 
     # 0) state-count divergence: ranks don't even agree how many states
@@ -541,6 +573,14 @@ def channel_is_suspect() -> bool:
     """True once a sync watchdog has fired: collective ordering is no longer
     trusted and new host syncs are refused until :func:`reset_channel_health`."""
     return _channel_suspect.is_set()
+
+
+def mark_channel_suspect() -> None:
+    """Latch the suspect flag from outside the watchdog — the async overlap
+    layer (``parallel/async_sync.py``) calls this when an in-flight round's
+    future cannot complete, which means a collective is stuck somewhere on
+    the background lane: exactly the condition the latch exists for."""
+    _channel_suspect.set()
 
 
 def reset_channel_health() -> None:

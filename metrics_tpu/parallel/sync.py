@@ -359,6 +359,7 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
     timeout: Optional[float] = None,
     metric_name: str = "metric",
     fused: Optional[bool] = None,
+    sync_epoch: int = 0,
 ) -> Dict[str, Any]:
     """Host-path sync of a whole metric-state dict across processes.
 
@@ -381,6 +382,12 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
     escape hatch); ``check_health=False`` always uses the per-leaf path
     (the planner requires a verified header).
 
+    ``sync_epoch`` tags the health word with the overlapped-sync round this
+    gather belongs to (``0`` = blocking): the header verifies the column
+    equal across ranks, so a rank resolving an in-flight background round
+    can never pair its collectives with a peer's foreground sync
+    (``parallel/async_sync.py`` sets it per round).
+
     Once a watchdog has fired anywhere in the process, the cross-process
     channel is *suspect* (the abandoned worker may still sit inside the
     timed-out gather, so a fresh collective could pair with a peer's stale
@@ -391,6 +398,7 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
     """
     if not jit_distributed_available():
         return {name: host_sync_leaf(value, reductions.get(name)) for name, value in state.items()}
+    from metrics_tpu.parallel.async_sync import sync_channel
     from metrics_tpu.parallel.health import channel_is_suspect
 
     if channel_is_suspect():
@@ -404,27 +412,34 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
             "process group and call "
             "metrics_tpu.parallel.health.reset_channel_health()."
         )
-    precheck = True
-    if check_health:
-        from metrics_tpu.parallel.health import build_health_word, verify_health_words
+    # the channel guard orders this whole sync after any in-flight
+    # background round (``parallel/async_sync.py``): a foreground sync first
+    # drains rounds already launched on every rank (program order is SPMD-
+    # identical, so the global collective order stays deterministic)
+    with sync_channel():
+        precheck = True
+        if check_health:
+            from metrics_tpu.parallel.health import build_health_word, verify_health_words
 
-        word = build_health_word(state, reductions, update_count=update_count)
-        words = np.asarray(_process_allgather(jnp.asarray(word), timeout=timeout))
-        verify_health_words(
-            words,
-            state,
-            reductions,
-            strict_update_count=strict_update_count,
-            metric_name=metric_name,
-        )
-        precheck = False
-        from metrics_tpu.parallel.bucketing import fused_sync_enabled, host_sync_state_bucketed
+            word = build_health_word(
+                state, reductions, update_count=update_count, sync_epoch=sync_epoch
+            )
+            words = np.asarray(_process_allgather(jnp.asarray(word), timeout=timeout))
+            verify_health_words(
+                words,
+                state,
+                reductions,
+                strict_update_count=strict_update_count,
+                metric_name=metric_name,
+            )
+            precheck = False
+            from metrics_tpu.parallel.bucketing import fused_sync_enabled, host_sync_state_bucketed
 
-        if fused is None:
-            fused = fused_sync_enabled()
-        if fused:
-            return host_sync_state_bucketed(state, reductions, words=words, timeout=timeout)
-    return {
-        name: host_sync_leaf(value, reductions.get(name), precheck=precheck, timeout=timeout)
-        for name, value in state.items()
-    }
+            if fused is None:
+                fused = fused_sync_enabled()
+            if fused:
+                return host_sync_state_bucketed(state, reductions, words=words, timeout=timeout)
+        return {
+            name: host_sync_leaf(value, reductions.get(name), precheck=precheck, timeout=timeout)
+            for name, value in state.items()
+        }

@@ -33,6 +33,19 @@ class SyncTimeoutError(SyncError):
     """
 
 
+class StaleSyncError(SyncError):
+    """An overlapped (non-blocking) sync resolved against a moved-on state.
+
+    Raised under ``staleness_policy="fresh"`` when the in-flight round's
+    gathered result corresponds to a snapshot older than the live
+    accumulation (``update()`` ran between launch and resolve). The stale
+    result is *reported*, never silently mixed: degrade via
+    ``on_error="local"`` (the full local accumulation is restored), resolve
+    earlier, or pick ``staleness_policy="snapshot"``/``"merge"`` to accept
+    bounded staleness (see ``parallel/async_sync.py``).
+    """
+
+
 class StateDivergenceError(SyncError):
     """Metric state diverged across processes before a sync.
 

@@ -96,12 +96,49 @@ def f(value, axis_name, fx):
     assert {f.rule for f in findings} == {"data-dependent-collective"}
 
 
+def test_async_round_api_is_known_emitting():
+    """launch/resolve/drain of an overlapped round schedule or consume
+    collectives, so their call sites are checked exactly like a direct
+    gather — a per-rank-data guard over any of them is a finding, and a
+    resolved round's result washes taint like any collective result."""
+    src = '''
+def maybe_launch(state, reductions):
+    if len(state) > 0:
+        return launch_round(state, reductions, update_count=1, epoch=1)
+    return None
+
+def maybe_resolve(round_, value):
+    if value.sum() > 0:
+        return resolve_round(round_)
+    return None
+
+def rank_zero_drain(round_):
+    import jax
+    if jax.process_index() == 0:
+        drain_round(round_)
+
+def clean_resolve(round_):
+    synced, wait_s = resolve_round(round_)
+    if synced.sum() > 0:      # collective result: symmetric guard
+        return host_sync_state(synced, {})
+    return synced
+'''
+    findings = run_schedule_pass(ast.parse(src), "<s>")
+    owners = by_function(findings)
+    assert owners["maybe_launch"] == {"data-dependent-collective"}
+    assert owners["maybe_resolve"] == {"data-dependent-collective"}
+    assert owners["rank_zero_drain"] == {"rank-dependent-collective"}
+    assert "clean_resolve" not in owners
+
+
 def test_shipped_parallel_modules_verify():
     """The tentpole invariant: every reachable path in parallel/{sync,health,
-    bucketing}.py emits collectives in rank/data-independent order — the two
-    deliberate exceptions (trace-time SPMD branches in sync_in_jit, the
-    channel-suspect refusal in host_sync_state) carry explicit, commented
-    suppressions and anything NEW must fail this test."""
+    bucketing,async_sync}.py emits collectives in rank/data-independent
+    order — the overlapped-sync module's launch/resolve/drain sites
+    included (KNOWN_EMITTING_CALLS). The deliberate exceptions (trace-time
+    SPMD branches in sync_in_jit, the channel-suspect refusal in
+    host_sync_state) carry explicit, commented suppressions and anything
+    NEW must fail this test."""
     import metrics_tpu
 
     parallel = os.path.join(os.path.dirname(metrics_tpu.__file__), "parallel")

@@ -13,12 +13,21 @@ states.
 Collectives must be issued with the watchdog disabled (``timeout=0`` →
 inline execution): the watchdog's worker thread would lose the rank's
 thread-local identity.
+
+Overlapped (non-blocking) sync rounds need one more seam: in production
+every rank is its own process with its own ``parallel/async_sync.py``
+executor, but here all fake ranks share one module, so each rank must get
+its own background lane whose worker thread *carries the rank's identity*
+(``executor_for_current_rank`` + an initializer propagating the
+thread-local) — monkeypatch it over ``async_sync._get_executor``.
 """
 import threading
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
+
+from metrics_tpu.parallel.async_sync import SerialExecutor
 
 __all__ = ["LockstepWorld"]
 
@@ -42,6 +51,39 @@ class LockstepWorld:
         self._barrier = threading.Barrier(world)
         self._slots: List[Optional[np.ndarray]] = [None] * world
         self._rank = threading.local()
+        self._executors: Dict[int, SerialExecutor] = {}
+        self._executors_lock = threading.Lock()
+
+    def executor_for_current_rank(self) -> SerialExecutor:
+        """Per-rank single-worker executor whose thread carries this rank's
+        thread-local identity — the ``async_sync._get_executor`` seam for
+        simulated worlds. One worker per rank preserves the production
+        property that a rank's rounds execute in launch order."""
+        rank = self._rank.value
+        with self._executors_lock:
+            ex = self._executors.get(rank)
+            if ex is None:
+
+                def _adopt_rank(r: int = rank) -> None:
+                    self._rank.value = r
+
+                ex = SerialExecutor(
+                    f"lockstep-async-rank{rank}", initializer=_adopt_rank
+                )
+                self._executors[rank] = ex
+            return ex
+
+    def rank_domain(self):
+        """This thread's rank identity (or ``None`` off-rank) — the
+        ``async_sync._current_domain`` seam: a fake rank must drain only its
+        OWN launched rounds, as a real per-process rank would."""
+        return getattr(self._rank, "value", None)
+
+    def shutdown_executors(self) -> None:
+        with self._executors_lock:
+            for ex in self._executors.values():
+                ex.shutdown(wait=False)
+            self._executors.clear()
 
     def allgather(self, x: Any):
         rank = self._rank.value

@@ -1,0 +1,631 @@
+"""Async overlapped sync equivalence + protocol suite (ISSUE 7 tentpole).
+
+The contract under test: a non-blocking, double-buffered sync round
+(``parallel/async_sync.py``, ``sync(blocking=False)``,
+``sync_mode="overlap"``) resolves **bit-identically** to a blocking sync of
+the same update stream — reduce states, CatBuffers and grouped collections
+included — while the collectives ride a background lane; staleness is
+reported per :attr:`staleness_policy`, never silently mixed; launch/resolve
+epochs are negotiated symmetrically through the health word (protocol v3);
+``unsync()`` mid-flight cancels by draining on every rank; and checkpoints
+refuse an in-flight round. Real two-rank payloads run through
+:class:`LockstepWorld` with one background executor lane per rank.
+"""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.parallel.async_sync as async_mod
+import metrics_tpu.parallel.sync as sync_mod
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu import Precision, Recall
+from metrics_tpu.parallel.bucketing import clear_sync_plan_cache, sync_plan_cache_info
+from metrics_tpu.parallel.health import reset_channel_health
+from metrics_tpu.utils.exceptions import (
+    MetricsTPUUserError,
+    StaleSyncError,
+    StateDivergenceError,
+)
+from tests.helpers.fake_world import LockstepWorld
+
+WORLD = 2
+
+
+@pytest.fixture(autouse=True)
+def _fresh_channel_and_plans():
+    clear_sync_plan_cache()
+    reset_channel_health()
+    with async_mod._PENDING_LOCK:
+        async_mod._PENDING.clear()
+    yield
+    clear_sync_plan_cache()
+    reset_channel_health()
+    with async_mod._PENDING_LOCK:
+        async_mod._PENDING.clear()
+
+
+@pytest.fixture
+def lockstep(monkeypatch):
+    """Two real ranks on threads, rendezvous collectives, and one
+    background async-sync lane per rank (the production per-process
+    executor, simulated per fake rank)."""
+    world = LockstepWorld(WORLD)
+    monkeypatch.setattr(jax, "process_count", lambda: world.world)
+    monkeypatch.setattr(sync_mod, "_raw_process_allgather", world.allgather)
+    monkeypatch.setattr(async_mod, "_get_executor", world.executor_for_current_rank)
+    monkeypatch.setattr(async_mod, "_current_domain", world.rank_domain)
+    yield world
+    world.shutdown_executors()
+
+
+class _Sum(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + jnp.asarray(jnp.size(x), jnp.int32)
+
+    def compute(self):
+        return self.total / self.count
+
+
+class _Cat(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("rows", [], dist_reduce_fx="cat")
+        self.add_state("seen", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.rows.append(x)
+        self.seen = self.seen + 1.0
+
+    def compute(self):
+        return jnp.concatenate([r[None] if r.ndim == 0 else r for r in self.rows])
+
+
+def _state_bytes(m):
+    out = []
+    for name in sorted(m._defaults):
+        v = m._state[name]
+        if isinstance(v, list):
+            out.append(tuple(np.asarray(x).tobytes() for x in v))
+        elif hasattr(v, "values") and hasattr(v, "capacity"):  # CatBuffer
+            out.append(np.asarray(v.values()).tobytes())
+        else:
+            out.append(np.asarray(v).tobytes())
+    return tuple(out)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: overlapped resolve ≡ blocking sync, same update stream
+# ---------------------------------------------------------------------------
+
+
+def test_overlap_resolves_bit_identical_reduce(lockstep):
+    def body(rank):
+        feed = jnp.asarray([1.0 + rank, 2.0 * (rank + 1)])
+        over, block = _Sum(sync_timeout=0), _Sum(sync_timeout=0)
+        over.update(feed)
+        block.update(feed)
+        block.sync()
+        over.sync(blocking=False)  # launch; returns un-synced
+        assert not over._is_synced and over.__dict__["_inflight"] is not None
+        over.sync()  # resolve the in-flight round
+        assert over._is_synced
+        bits = (_state_bytes(over), _state_bytes(block))
+        over.unsync()
+        block.unsync()
+        stats = over.sync_stats()
+        assert stats["launched"] == 1 and stats["resolved"] == 1
+        assert stats["stale_resolves"] == 0
+        return bits, _state_bytes(over), _state_bytes(block)
+
+    results = lockstep.run(body)
+    for (synced_o, synced_b), local_o, local_b in results:
+        assert synced_o == synced_b  # bit-identical synced view
+        assert local_o == local_b  # bit-identical restored locals
+
+
+def test_overlap_resolves_bit_identical_catbuffer(lockstep):
+    def body(rank):
+        over = _Cat(sync_timeout=0).with_capacity(16)
+        block = _Cat(sync_timeout=0).with_capacity(16)
+        for i in range(2 + rank):  # uneven rows per rank
+            row = jnp.asarray([float(rank), float(i), 1.0])
+            over.update(row)
+            block.update(row)
+        block.sync()
+        over.sync(blocking=False)
+        over.sync()
+        bits = (_state_bytes(over), _state_bytes(block))
+        over.unsync()
+        block.unsync()
+        return bits, _state_bytes(over), _state_bytes(block)
+
+    for (synced_o, synced_b), local_o, local_b in lockstep.run(body):
+        assert synced_o == synced_b
+        assert local_o == local_b
+
+
+def test_overlap_grouped_collection_bit_identical(lockstep):
+    preds = [jnp.asarray(np.random.RandomState(3 + r).rand(24, 5).astype(np.float32)) for r in range(WORLD)]
+    target = [jnp.asarray(np.random.RandomState(7 + r).randint(0, 5, (24,))) for r in range(WORLD)]
+
+    def make():
+        mc = MetricCollection(
+            {
+                "prec": Precision(num_classes=5, average="macro"),
+                "rec": Recall(num_classes=5, average="macro"),
+            }
+        )
+        for m in mc.values():
+            m.sync_timeout = 0
+        return mc
+
+    def body(rank):
+        over, block = make(), make()
+        over.update(preds[rank], target[rank])
+        block.update(preds[rank], target[rank])
+        assert over.compute_group_keys  # the pair actually grouped
+        block.sync()
+        over.sync(blocking=False)
+        assert over.__dict__["_inflight_round"] is not None
+        over.sync()  # resolve: all members applied all-or-nothing
+        bits = tuple(_state_bytes(m) for m in over.values())
+        bbits = tuple(_state_bytes(m) for m in block.values())
+        vals = {k: np.asarray(v) for k, v in over.compute().items()}
+        bvals = {k: np.asarray(v) for k, v in block.compute().items()}
+        over.unsync()
+        block.unsync()
+        stats = over.sync_stats()
+        assert stats["collection"]["launched"] == 1
+        assert stats["collection"]["resolved"] == 1
+        return bits, bbits, vals, bvals
+
+    for bits, bbits, vals, bvals in lockstep.run(body):
+        assert bits == bbits
+        for k in vals:
+            assert (vals[k] == bvals[k]).all()
+
+
+def test_collection_overlap_uses_one_fused_round(lockstep):
+    def body(rank):
+        mc = MetricCollection({"a": _Sum(sync_timeout=0), "b": _Sum(sync_timeout=0)})
+        mc.update(jnp.asarray([1.0 + rank]))
+        before = lockstep.calls
+        mc.sync(blocking=False)
+        mc.sync()
+        mc.unsync()
+        return lockstep.calls - before
+
+    rounds = lockstep.run(body)
+    # ONE header + one reduce bucket (f32) + one (i32) for the whole
+    # two-member collection — same collective budget as the blocking fused
+    # path, just off the critical path (`calls` counts rendezvous rounds,
+    # shared by both ranks)
+    assert rounds[0] <= 3
+
+
+# ---------------------------------------------------------------------------
+# staleness policies
+# ---------------------------------------------------------------------------
+
+
+def _stale_setup(rank, policy, **kwargs):
+    m = _Sum(sync_timeout=0, staleness_policy=policy, **kwargs)
+    m.update(jnp.asarray([1.0 + rank]))  # snapshot accumulation: 1+rank
+    m.sync(blocking=False)
+    m.update(jnp.asarray([10.0]))  # post-snapshot delta on every rank
+    return m
+
+
+def test_staleness_snapshot_serves_consistent_cut(lockstep):
+    def body(rank):
+        m = _stale_setup(rank, "snapshot")
+        m.sync()
+        synced = float(np.asarray(m.total))
+        m.unsync()
+        local = float(np.asarray(m.total))
+        assert m.sync_stats()["stale_resolves"] == 1
+        return synced, local
+
+    for rank, (synced, local) in enumerate(lockstep.run(body)):
+        assert synced == pytest.approx(3.0)  # (1+0) + (1+1): the snapshot cut
+        assert local == pytest.approx(1.0 + rank + 10.0)  # full accumulation
+
+
+def test_staleness_merge_folds_local_delta(lockstep):
+    def body(rank):
+        m = _stale_setup(rank, "merge")
+        m.sync()
+        synced = float(np.asarray(m.total))
+        m.unsync()
+        return synced, float(np.asarray(m.total))
+
+    for rank, (synced, local) in enumerate(lockstep.run(body)):
+        assert synced == pytest.approx(3.0 + 10.0)  # world cut + THIS rank's delta
+        assert local == pytest.approx(11.0 + rank)
+
+
+def test_staleness_fresh_raises_typed_and_degrades(lockstep):
+    def body(rank):
+        m = _stale_setup(rank, "fresh")
+        with pytest.raises(StaleSyncError):
+            m.sync()
+        # the full accumulation was restored before the raise
+        assert float(np.asarray(m.total)) == pytest.approx(11.0 + rank)
+        # degradation path: local fallback keeps the accumulation (the
+        # LOCAL-ONLY warning itself is asserted in the single-threaded
+        # fault-injection suite — warning capture is not thread-safe here)
+        m2 = _stale_setup(rank, "fresh", sync_on_error="local")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m2.sync()
+        assert not m2._is_synced and m2._sync_degraded
+        assert m2.sync_stats()["degraded"] == 1
+        assert float(np.asarray(m2.total)) == pytest.approx(11.0 + rank)
+        m2.unsync()  # tolerated no-op after degradation
+        return True
+
+    assert all(lockstep.run(body))
+
+
+# ---------------------------------------------------------------------------
+# epoch negotiation + cancel + pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_skew_raises_symmetrically(lockstep):
+    """Rank 0 resolving overlapped round 1 while rank 1 contributes a
+    blocking sync (epoch 0) is the background/foreground mispairing the
+    health word's sync_epoch column (protocol v3) must catch on BOTH
+    ranks."""
+
+    def body(rank):
+        m = _Sum(sync_timeout=0)
+        m.update(jnp.asarray([1.0 + rank]))
+        if rank == 0:
+            m.sync(blocking=False)
+            with pytest.raises(StateDivergenceError, match="sync-round skew"):
+                m.sync()
+            return "resolved-skew"
+        with pytest.raises(StateDivergenceError, match="sync-round skew"):
+            m.sync()
+        return "blocking-skew"
+
+    assert lockstep.run(body) == ["resolved-skew", "blocking-skew"]
+
+
+def test_unsync_mid_flight_cancels_by_draining(lockstep):
+    def body(rank):
+        m = _Sum(sync_timeout=0)
+        m.update(jnp.asarray([2.0 + rank]))
+        m.sync(blocking=False)
+        m.update(jnp.asarray([5.0]))  # delta while the round flies
+        m.unsync()  # cancel: drain + fold back, never future.cancel()
+        assert m.__dict__.get("_inflight") is None
+        stats = m.sync_stats()
+        assert stats["cancelled"] == 1 and stats["resolved"] == 0
+        local = float(np.asarray(m.total))
+        # a later blocking sync still works (the channel stayed healthy)
+        m.sync()
+        world_total = float(np.asarray(m.total))
+        m.unsync()
+        return local, world_total
+
+    for rank, (local, world_total) in enumerate(lockstep.run(body)):
+        assert local == pytest.approx(7.0 + rank)
+        assert world_total == pytest.approx(7.0 + 8.0)
+
+
+def test_overlap_pipeline_compute_every_n(lockstep):
+    """sync_mode="overlap": compute() serves the previous interval's world
+    value (first call: local) while the next round rides behind the step —
+    the compute()-every-N-costs-~0 contract."""
+    K = 3
+
+    def body(rank):
+        m = _Sum(sync_timeout=0, sync_mode="overlap")
+        values = []
+        for _interval in range(3):
+            for _ in range(K):
+                m.update(jnp.asarray([float(rank + 1)]))
+            values.append(float(np.asarray(m.compute())))
+            m._computed = None  # next interval recomputes
+        stats = m.sync_stats()
+        m.unsync()  # drain the tail round symmetrically
+        return values, stats
+
+    results = lockstep.run(body)
+    for rank, (values, stats) in enumerate(results):
+        # interval 1: no resolved round yet — local-only serve (mean = rank+1)
+        assert values[0] == pytest.approx(rank + 1.0)
+        # intervals 2..: the PREVIOUS interval's world snapshot (both ranks'
+        # accumulations at that cut), identical on both ranks
+        assert values[1] == pytest.approx(1.5)
+        assert values[2] == pytest.approx(1.5)
+        assert stats["launched"] == 3
+        assert stats["resolved"] == 2
+        assert stats["served_local"] == 1
+
+
+def test_collection_overlap_pipeline(lockstep):
+    K = 2
+
+    def body(rank):
+        mc = MetricCollection(
+            {"a": _Sum(sync_timeout=0), "b": _Sum(sync_timeout=0)},
+            sync_mode="overlap",
+        )
+        values = []
+        for _interval in range(3):
+            for _ in range(K):
+                mc.update(jnp.asarray([float(rank + 1)]))
+            vals = mc.compute()
+            values.append({k: float(np.asarray(v)) for k, v in vals.items()})
+            for m in mc.values():
+                m._computed = None
+        assert all(not m._is_synced for m in mc.values())  # restored each time
+        mc.unsync()  # drain the tail round
+        stats = mc.sync_stats()["collection"]
+        assert stats["launched"] == 3 and stats["resolved"] == 2
+        assert stats["served_local"] == 1
+        return values
+
+    for rank, values in enumerate(lockstep.run(body)):
+        assert values[0]["a"] == pytest.approx(rank + 1.0)  # local serve
+        assert values[1]["a"] == pytest.approx(3.0 / 2.0)  # previous world cut
+        assert values[2]["a"] == pytest.approx(3.0 / 2.0)
+
+
+def test_member_read_resolves_collection_round(lockstep):
+    def body(rank):
+        mc = MetricCollection({"a": _Sum(sync_timeout=0), "b": _Sum(sync_timeout=0)})
+        mc.update(jnp.asarray([1.0 + rank]))
+        mc.sync(blocking=False)
+        # a single member's compute() resolves the WHOLE collection round
+        val = float(np.asarray(mc["a"].compute()))
+        assert mc.__dict__["_inflight_round"] is None
+        assert mc["b"]._is_synced  # sibling left synced (all-or-nothing)
+        mc.unsync()
+        assert not mc["b"]._is_synced
+        return val
+
+    for val in lockstep.run(body):
+        assert val == pytest.approx((1.0 + 2.0) / 2.0)
+
+
+def test_member_reset_cancels_collection_round(lockstep):
+    """reset() on one member while a COLLECTION round is in flight must
+    cancel the round (symmetric drain + fold-back) — otherwise the resolve
+    would resurrect the pre-reset accumulation."""
+
+    def body(rank):
+        mc = MetricCollection({"a": _Sum(sync_timeout=0), "b": _Sum(sync_timeout=0)})
+        mc.update(jnp.asarray([1.0 + rank]))
+        mc.sync(blocking=False)
+        mc["a"].reset()
+        assert mc.__dict__["_inflight_round"] is None
+        assert mc.sync_stats()["collection"]["cancelled"] == 1
+        # "a" is reset, "b" kept its folded-back accumulation
+        a_local = float(np.asarray(mc["a"].total))
+        b_local = float(np.asarray(mc["b"].total))
+        return a_local, b_local
+
+    for rank, (a_local, b_local) in enumerate(lockstep.run(body)):
+        assert a_local == 0.0
+        assert b_local == pytest.approx(1.0 + rank)
+
+
+def test_collection_serve_local_caches_are_delta_buffers(lockstep):
+    """The pipeline's first interval serves the snapshot view, but every
+    member's unsync cache — group peers included — must hold the fresh
+    DELTA buffers: unsync restores the delta side of the double buffer,
+    never the snapshot (which the in-flight round owns)."""
+    preds = [jnp.asarray(np.random.RandomState(13 + r).rand(16, 5).astype(np.float32)) for r in range(WORLD)]
+    target = [jnp.asarray(np.random.RandomState(17 + r).randint(0, 5, (16,))) for r in range(WORLD)]
+
+    def body(rank):
+        mc = MetricCollection(
+            {
+                "prec": Precision(num_classes=5, average="macro"),
+                "rec": Recall(num_classes=5, average="macro"),
+            },
+            sync_mode="overlap",
+        )
+        for m in mc.values():
+            m.sync_timeout = 0
+        mc.update(preds[rank], target[rank])
+        assert mc.compute_group_keys
+        mc.sync()  # auto overlap: launch + serve local
+        for m in mc.values():
+            defaults = m._default_state()
+            for name in defaults:
+                assert (
+                    np.asarray(m._cache[name]).tobytes()
+                    == np.asarray(defaults[name]).tobytes()
+                ), name
+        mc.unsync()  # members back on their (empty) delta buffers
+        for m in mc.values():
+            defaults = m._default_state()
+            for name in defaults:
+                assert (
+                    np.asarray(m._state[name]).tobytes()
+                    == np.asarray(defaults[name]).tobytes()
+                ), name
+        mc.unsync()  # cancel the pending round: fold the accumulation back
+        total = float(sum(np.asarray(m._state["tp"]).sum() for m in mc.values()))
+        return total
+
+    totals = lockstep.run(body)
+    assert all(t > 0 for t in totals)  # accumulation survived the cancel
+
+
+def test_collection_deepcopy_and_pickle_drain_inflight_round(lockstep):
+    import copy
+    import pickle
+
+    def body(rank):
+        mc = MetricCollection({"a": _Sum(sync_timeout=0), "b": _Sum(sync_timeout=0)})
+        mc.update(jnp.asarray([1.0 + rank]))
+        mc.sync(blocking=False)
+        clone = copy.deepcopy(mc)  # drains symmetrically, no thread-lock crash
+        assert mc.__dict__["_inflight_round"] is None
+        mc.update(jnp.asarray([1.0]))
+        mc.sync(blocking=False)
+        blob = pickle.dumps(mc)  # same guard on the pickle path
+        restored = pickle.loads(blob)
+        return (
+            float(np.asarray(clone["a"].total)),
+            float(np.asarray(restored["a"].total)),
+        )
+
+    for rank, (cloned, restored) in enumerate(lockstep.run(body)):
+        assert cloned == pytest.approx(1.0 + rank)
+        assert restored == pytest.approx(2.0 + rank)
+
+
+def test_member_clone_under_collection_round_keeps_accumulation(lockstep):
+    """Cloning (or pickling) a single MEMBER while a COLLECTION round owns
+    its accumulation must drain the round first — the copy would otherwise
+    silently capture only the post-snapshot delta."""
+    import copy
+
+    def body(rank):
+        mc = MetricCollection({"a": _Sum(sync_timeout=0), "b": _Sum(sync_timeout=0)})
+        mc.update(jnp.asarray([5.0 + rank]))
+        mc.sync(blocking=False)
+        mc.update(jnp.asarray([7.0]))  # delta while the round flies
+        clone = copy.deepcopy(mc["a"])
+        assert mc.__dict__["_inflight_round"] is None  # round drained
+        return float(np.asarray(clone.total)), float(np.asarray(mc["a"].total))
+
+    for rank, (cloned, live) in enumerate(lockstep.run(body)):
+        assert cloned == pytest.approx(12.0 + rank)  # snapshot ⊕ delta, not delta
+        assert live == pytest.approx(12.0 + rank)
+
+
+def test_plan_cache_reused_across_rounds(lockstep):
+    def body(rank):
+        m = _Sum(sync_timeout=0)
+        for i in range(3):
+            m.update(jnp.asarray([1.0 + rank + i]))
+            m.sync(blocking=False)
+            m.sync()
+            m.unsync()
+        return True
+
+    assert all(lockstep.run(body))
+    info = sync_plan_cache_info()
+    # one plan built, every later overlapped round hits it (both ranks +
+    # background lanes share the lock-protected cache)
+    assert info["misses"] == 1
+    assert info["hits"] >= 4
+
+
+# ---------------------------------------------------------------------------
+# interactions: checkpoint refusal, compiled updates, update-while-in-flight
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_refuses_in_flight_round(lockstep, tmp_path):
+    from metrics_tpu.core.checkpoint import save_checkpoint
+
+    def body(rank):
+        m = _Sum(sync_timeout=0)
+        m.update(jnp.asarray([1.0 + rank]))
+        m.sync(blocking=False)
+        with pytest.raises(MetricsTPUUserError, match="in flight"):
+            save_checkpoint(m, str(tmp_path / f"ck{rank}"), rank=rank, world=WORLD)
+        m.unsync()  # cancel; now the snapshot is legal again
+        path = save_checkpoint(m, str(tmp_path / f"ck{rank}"), rank=rank, world=WORLD)
+        return bool(path)
+
+    assert all(lockstep.run(body))
+
+
+def test_compiled_updates_ride_the_overlap_window(lockstep):
+    """The donation discipline: launch clears `_donation_ready`, so compiled
+    (donating) updates during the window can never invalidate the snapshot
+    the background gather is reading — values stay bit-identical."""
+
+    def body(rank):
+        over = _Sum(sync_timeout=0, compiled_update=True)
+        block = _Sum(sync_timeout=0, compiled_update=True)
+        for i in range(3):  # compiled from step 1 (knob skips warm-up)
+            x = jnp.asarray([1.0 + rank + i])
+            over.update(x)
+            block.update(x)
+        over.sync(blocking=False)
+        for m, i in ((over, 3), (block, 3)):  # compiled delta updates mid-flight
+            m.update(jnp.asarray([2.0 * rank + i]))
+        block.sync()
+        over.staleness_policy = "merge"  # fold the delta: same data as block
+        over.sync()
+        bits = (_state_bytes(over), _state_bytes(block))
+        over.unsync()
+        block.unsync()
+        assert over.compile_stats()["dispatches"] > 0  # the path actually engaged
+        return bits, _state_bytes(over), _state_bytes(block)
+
+    for (synced_o, synced_b), local_o, local_b in lockstep.run(body):
+        assert local_o == local_b
+
+
+def test_state_dict_resolves_in_flight_round(lockstep):
+    def body(rank):
+        m = _Sum(sync_timeout=0)
+        m.persistent(True)
+        m.update(jnp.asarray([1.0 + rank]))
+        m.sync(blocking=False)
+        snap = m.state_dict()  # resolves: the snapshot is the SYNCED view
+        assert m._is_synced
+        m.unsync()
+        return float(np.asarray(snap["total"])), float(np.asarray(m.total))
+
+    for rank, (synced_total, local_total) in enumerate(lockstep.run(body)):
+        assert synced_total == pytest.approx(3.0)
+        assert local_total == pytest.approx(1.0 + rank)
+
+
+def test_reset_drains_in_flight_round(lockstep):
+    def body(rank):
+        m = _Sum(sync_timeout=0)
+        m.update(jnp.asarray([1.0 + rank]))
+        m.sync(blocking=False)
+        m.reset()
+        assert m.__dict__.get("_inflight") is None
+        assert m.sync_stats()["cancelled"] == 1
+        assert float(np.asarray(m.total)) == 0.0
+        return True
+
+    assert all(lockstep.run(body))
+
+
+def test_overlap_refused_for_non_mergeable_state(lockstep):
+    class _NoMerge(Metric):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("v", jnp.zeros(()), dist_reduce_fx="mean")
+
+        def update(self, x):
+            self.v = (self.v + jnp.mean(x)) / 2.0
+
+        def compute(self):
+            return self.v
+
+    def body(rank):
+        m = _NoMerge(sync_timeout=0)
+        m.update(jnp.asarray([1.0]))
+        with pytest.raises(MetricsTPUUserError, match="merge"):
+            m.sync(blocking=False)
+        return True
+
+    assert all(lockstep.run(body))

@@ -31,6 +31,7 @@ from metrics_tpu.parallel.health import (
     HEALTH_PROTOCOL_VERSION,
     NONFINITE_STATE,
     WORD_WIDTH,
+    _F_EPOCH,
     _F_FIXED,
     _F_LENGTHS,
     _F_NONFINITE,
@@ -174,8 +175,9 @@ def _assert_symmetric_raise(exc_type, words, state, reds, **kwargs):
         (_F_SCHEMA, 12345, StateDivergenceError),  # num_classes-style mis-config
         (_F_OVERFLOW, 1, SyncError),  # CatBuffer overflow on a peer
         (_F_NONFINITE, 1, NonFiniteStateError),  # NaN/Inf-poisoned peer
+        (_F_EPOCH, 7, StateDivergenceError),  # overlapped-round skew (v3)
     ],
-    ids=["version-skew", "schema-mismatch", "overflow", "non-finite"],
+    ids=["version-skew", "schema-mismatch", "overflow", "non-finite", "epoch-skew"],
 )
 def test_divergence_classes_raise_symmetrically(col, value, exc_type):
     state, reds = _catbuf_state()
@@ -599,6 +601,115 @@ def test_unsync_tolerated_after_degraded_sync(fake_world):
     # ...but the guard still fires for a genuinely unpaired unsync
     with pytest.raises(MetricsTPUUserError, match="already been un-synced"):
         m.unsync()
+
+
+# ---------------------------------------------------------------------------
+# async (overlapped) sync path: the same divergence classes surface at
+# RESOLVE time with identical typed errors and on_error degradation, and the
+# channel-suspect latch covers the background thread
+# ---------------------------------------------------------------------------
+
+
+def test_async_dead_rank_mid_flight_times_out_at_resolve(fake_world):
+    # the peer dies while the round is in flight: the background thread's
+    # watchdog fires, the typed timeout surfaces at the next read
+    m = _distributed_metric(fake_world, EchoAllgather(delay_s=3.0))
+    m.sync_timeout = 0.2
+    m.update(jnp.asarray(1.0))
+    m.sync(blocking=False)
+    with pytest.raises(SyncTimeoutError):
+        m.sync()
+    # the accumulation survived the failed round
+    np.testing.assert_allclose(np.asarray(m.x), 1.0)
+
+
+def test_async_watchdog_fire_latches_channel_suspect(fake_world):
+    m = _distributed_metric(fake_world, EchoAllgather(delay_s=3.0))
+    m.sync_timeout = 0.2
+    m.update(jnp.asarray(1.0))
+    m.sync(blocking=False)
+    with pytest.raises(SyncTimeoutError):
+        m.sync()
+    # the background watchdog poisoned collective ordering process-wide:
+    # a NEW blocking sync refuses up front, exactly like the foreground case
+    assert channel_is_suspect()
+    m2 = DummyMetricSum()
+    m2.distributed_available_fn = lambda: True
+    m2.update(jnp.asarray(2.0))
+    with pytest.raises(SyncTimeoutError, match="refused"):
+        m2.sync()
+    reset_channel_health()
+
+
+def test_async_divergent_header_at_resolve(fake_world):
+    m = _distributed_metric(fake_world, EchoAllgather(mutate_first=_schema_diverge))
+    m.update(jnp.asarray(1.0))
+    m.sync(blocking=False)
+    with pytest.raises(StateDivergenceError):
+        m.sync()
+    np.testing.assert_allclose(np.asarray(m.x), 1.0)  # fold-back before raise
+
+
+def test_async_degrades_local_then_blocking_sync_recovers(fake_world):
+    # round 1 hits a divergent peer; on_error="local" keeps the local
+    # accumulation; once the divergence clears, a LATER blocking sync of the
+    # same metric recovers the global view
+    echo = EchoAllgather(mutate_first=_schema_diverge)
+    m = _distributed_metric(fake_world, echo)
+    m.sync_on_error = "local"
+    m.update(jnp.asarray(1.0))
+    m.sync(blocking=False)
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        m.sync()
+    assert not m._is_synced and m._sync_degraded
+    assert m.sync_stats()["degraded"] == 1
+    np.testing.assert_allclose(np.asarray(m.x), 1.0)
+    m.unsync()  # tolerated no-op after the degradation
+    # the transient divergence clears (mutate_first hit only the first
+    # gather): blocking sync now succeeds and reports the world value
+    m.sync()
+    assert m._is_synced
+    np.testing.assert_allclose(np.asarray(m.x), 2.0)  # echo world of 2
+    m.unsync()
+
+
+def test_custom_dist_sync_fn_drains_pending_rounds(fake_world):
+    # the foreground-drains-first ordering invariant applies to custom
+    # transports too: a blocking custom-fn sync must not issue collectives
+    # while another metric's background round is still running
+    slow = EchoAllgather(delay_s=0.3)
+    a = _distributed_metric(fake_world, slow)
+    a.sync_timeout = 0  # watchdog off; the background gather takes ~0.3 s
+    a.update(jnp.asarray(1.0))
+    a.sync(blocking=False)
+    b = DummyMetricSum()
+    b.distributed_available_fn = lambda: True
+    b.update(jnp.asarray(2.0))
+    seen = {}
+
+    def fn(state, reds):
+        seen["a_round_done"] = a.__dict__["_inflight"].future.done()
+        return state
+
+    b.sync(dist_sync_fn=fn)
+    assert seen["a_round_done"]  # b's transport ran only after a's round
+    b.unsync()
+    a.unsync()  # drain/cancel a's (already finished) round
+
+
+def test_async_update_while_in_flight_then_snapshot_policy(fake_world):
+    # updates during the window accumulate into the delta buffer; a
+    # "snapshot" resolve serves the consistent cut and unsync restores the
+    # full accumulation — nothing is silently mixed
+    m = _distributed_metric(fake_world, EchoAllgather())
+    m.update(jnp.asarray(1.0))
+    m.sync(blocking=False)
+    m.update(jnp.asarray(10.0))
+    m.sync()
+    assert m.sync_stats()["stale_resolves"] == 1
+    np.testing.assert_allclose(np.asarray(m.x), 2.0)  # echo world of snapshot 1.0
+    m.unsync()
+    np.testing.assert_allclose(np.asarray(m.x), 11.0)
 
 
 def test_catbuffer_has_nonfinite():
