@@ -70,6 +70,11 @@ RULES: Dict[str, str] = {
         "a collective is emitted while iterating an unordered set — emission "
         "order must be deterministic and identical on every rank"
     ),
+    "guarded-telemetry-emit": (
+        "an observability journal emission (record()) sits under a rank- or "
+        "per-rank-data-dependent branch — ranks would record different event "
+        "journals, breaking cross-rank trace correlation"
+    ),
 }
 
 _SUPPRESS_RE = re.compile(r"#\s*metricslint:\s*disable=([A-Za-z0-9_,\- ]+)")

@@ -88,6 +88,18 @@ KNOWN_EMITTING_CALLS = frozenset(
     }
 )
 
+#: the observability recorder's emit calls (``observability/journal.py``).
+#: Known NON-collective: calling one never emits, schedules or consumes a
+#: cross-rank collective, so it must never be flagged as one — and, unlike
+#: :data:`_SYMMETRIC_CALLS`, its result must never WASH taint (``record``
+#: returns ``None``; treating it as symmetric would silently launder any
+#: tainted value routed through an emission expression). Emission sites in
+#: ``parallel/`` hot paths have their own contract instead: they must be
+#: guard-free — an "emit only on this rank / only for this data" branch
+#: would skew per-rank journals, breaking the cross-rank trace correlation
+#: the exporter keys on ``sync_epoch`` (rule ``guarded-telemetry-emit``).
+RECORDER_CALLS = frozenset({"record"})
+
 #: parameter names that carry per-rank data by module convention
 LOCAL_DATA_PARAMS = frozenset(
     {"state", "value", "values", "result", "x", "word", "update_count", "local_value"}
@@ -112,8 +124,12 @@ _SYMMETRIC_CALLS = COLLECTIVE_CALLS | KNOWN_EMITTING_CALLS | frozenset(
         # type/shape predicates are schema, which the header verifies equal
         "isinstance",
         "callable",
-        # the sync plan is a pure function of the (header-verified) schema
+        # the sync plan is a pure function of the (header-verified) schema,
+        # and the canonical schema string it is keyed on is itself verified
+        # equal across ranks by the header CRC before any payload moves
         "build_sync_plan",
+        "_classify",
+        "state_schema_parts",
     }
 )
 
@@ -126,8 +142,10 @@ class _FnInfo:
     name: str
     node: ast.FunctionDef
     emits_direct: bool = False
+    records_direct: bool = False
     calls: Set[str] = field(default_factory=set)
-    emits: bool = False  # transitive, filled by fixpoint
+    emits: bool = False    # transitive, filled by fixpoint
+    records: bool = False  # transitive recorder emission, same fixpoint
 
 
 def _call_name(func: ast.expr) -> Optional[str]:
@@ -152,19 +170,26 @@ def _module_functions(tree: ast.Module) -> Dict[str, _FnInfo]:
                 if name in COLLECTIVE_CALLS:
                     info.emits_direct = True
                 elif name:
+                    if name in RECORDER_CALLS:
+                        info.records_direct = True
                     info.calls.add(name)
         out.setdefault(node.name, info)
-    # transitive emission fixpoint over the intra-module call graph
+    # transitive emission fixpoint over the intra-module call graph — one
+    # fixpoint each for collective emission and recorder emission (a local
+    # helper wrapping record() must not defeat guarded-telemetry-emit any
+    # more than a wrapper around a gather defeats the collective rules)
     changed = True
     for info in out.values():
         info.emits = info.emits_direct
+        info.records = info.records_direct
     while changed:
         changed = False
         for info in out.values():
-            if info.emits:
-                continue
-            if any(c in out and out[c].emits for c in info.calls):
+            if not info.emits and any(c in out and out[c].emits for c in info.calls):
                 info.emits = True
+                changed = True
+            if not info.records and any(c in out and out[c].records for c in info.calls):
+                info.records = True
                 changed = True
     return out
 
@@ -314,6 +339,15 @@ def check_function(
             return True
         return name in fns and fns[name].emits and name != info.name
 
+    def records(node: ast.Call) -> bool:
+        # direct record() calls AND calls of local helpers that (transitively)
+        # record — wrapping the emission in a one-line helper must not
+        # silently defeat the guard-free contract
+        name = _call_name(node.func)
+        if name in RECORDER_CALLS:
+            return True
+        return name in fns and fns[name].records and name != info.name
+
     def has_early_exit(body: Sequence[ast.stmt]) -> bool:
         for stmt in body:
             if isinstance(stmt, (ast.Raise, ast.Return, ast.Continue, ast.Break)):
@@ -357,11 +391,16 @@ def check_function(
                 for node in ast.walk(stmt):
                     if isinstance(node, ast.Call) and emits(node):
                         report(node, ctx, stmt)
+                    elif isinstance(node, ast.Call) and records(node):
+                        report_recorder(node, ctx)
                     elif isinstance(node, ast.IfExp) and taint.classify(node.test) is not None:
                         t = taint.classify(node.test)
+                        inner = _Ctx(ctx.guards + ((t, node.lineno),), ctx.handler, ctx.set_loop)
                         for sub in ast.walk(node):
                             if isinstance(sub, ast.Call) and emits(sub):
-                                report(sub, _Ctx(ctx.guards + ((t, node.lineno),), ctx.handler, ctx.set_loop), stmt)
+                                report(sub, inner, stmt)
+                            elif isinstance(sub, ast.Call) and records(sub):
+                                report_recorder(sub, inner)
 
     def report(node: ast.Call, ctx: _Ctx, stmt: ast.stmt) -> None:
         name = _call_name(node.func) or "<collective>"
@@ -404,6 +443,24 @@ def check_function(
                 )
             )
 
+    def report_recorder(node: ast.Call, ctx: _Ctx) -> None:
+        """Telemetry emission under a rank/data-dependent guard: the journal
+        would record the event on some ranks only, skewing the cross-rank
+        event sequences the trace exporter correlates. (Guards on symmetric
+        config — ``journal.ACTIVE``, env knobs — are fine and unflagged.)"""
+        name = _call_name(node.func) or "record"
+        for t, line in list(ctx.guards) + early_exits:
+            findings.append(
+                Finding(
+                    "guarded-telemetry-emit", path, node.lineno, node.col_offset,
+                    f"{info.name}: telemetry emission {name}() is governed by a "
+                    f"{'rank' if t == 'rank' else 'per-rank data'}-dependent branch "
+                    f"(line {line}) — ranks taking different sides record different "
+                    "journals, breaking cross-rank trace correlation",
+                    owner=info.name,
+                )
+            )
+
     walk(info.node.body, _Ctx())
     # deduplicate (the same call can be reported once per governing guard —
     # keep that — but identical (rule, line, col, message) entries collapse)
@@ -425,6 +482,10 @@ def run_schedule_pass(tree: ast.Module, path: str) -> List[Finding]:
             info.emits_direct
             or any(c in fns and fns[c].emits for c in info.calls)
             or any(c in KNOWN_EMITTING_CALLS for c in info.calls)
+            # functions that only EMIT TELEMETRY are checked too (including
+            # via local record()-wrapping helpers): their emission sites
+            # must be guard-free of per-rank branches
+            or info.records
         ):
             continue
         findings.extend(check_function(fns, info, path))

@@ -260,6 +260,21 @@ def _fx_tag(fx: Any) -> Optional[str]:
     return "callable"
 
 
+def _refuse_snapshot(m: Metric, reason: str) -> None:
+    """Count + journal a snapshot refusal before the typed error raises —
+    a fleet watching ``telemetry()`` sees WHY its checkpoint cadence
+    stalled instead of inferring it from missing step directories."""
+    from metrics_tpu.observability import journal
+    from metrics_tpu.observability.registry import registry_of
+
+    registry_of(m).inc("checkpoint", "refused")
+    if journal.ACTIVE:
+        journal.record(
+            "checkpoint.refused", label=type(m).__name__,
+            step=getattr(m, "_update_count", -1), reason=reason,
+        )
+
+
 def _metric_record(m: Metric, writer: _PayloadWriter) -> Dict[str, Any]:
     if m.__dict__.get("_inflight") is not None or m.__dict__.get("_inflight_collection") is not None:
         # refuse rather than drain: the live state holds only the
@@ -267,6 +282,7 @@ def _metric_record(m: Metric, writer: _PayloadWriter) -> Dict[str, Any]:
         # accumulation, and an implicit drain here would silently serialize
         # a collective stall into the checkpoint cadence. The caller decides:
         # resolve (compute()/sync()) or cancel (unsync()) first.
+        _refuse_snapshot(m, "in-flight non-blocking sync round")
         raise MetricsTPUUserError(
             f"save_checkpoint: {type(m).__name__} has a non-blocking sync round "
             "in flight — the live state holds only the post-snapshot delta. "
@@ -274,6 +290,7 @@ def _metric_record(m: Metric, writer: _PayloadWriter) -> Dict[str, Any]:
             "before snapshotting."
         )
     if m._is_synced:
+        _refuse_snapshot(m, "state is synced (snapshots serialize pre-sync state)")
         raise MetricsTPUUserError(
             f"save_checkpoint: {type(m).__name__} is currently synced. Snapshots "
             "serialize the PRE-sync rank-local state (so elastic resume can fold "
@@ -516,11 +533,36 @@ def save_checkpoint(
                 step = newest
             else:
                 step = newest + 1
-    manifest, payload = _build_snapshot(metric, step=step, rank=rank, world=world)
+    # the transitive record() under here is _refuse_snapshot's: refusal
+    # events are per-rank facts by design (each rank snapshots its own
+    # shard), like the save/load/prune events below
+    manifest, payload = _build_snapshot(metric, step=step, rank=rank, world=world)  # metricslint: disable=guarded-telemetry-emit
     path = os.path.join(_step_dir(directory, step), _shard_name(rank, world))
     _atomic_write(path, _pack(manifest, payload))
+    from metrics_tpu.observability import journal
+    from metrics_tpu.observability.registry import registry_of
+
+    registry_of(metric).inc("checkpoint", "saves")
+    if journal.ACTIVE:
+        # checkpoint events are per-rank facts BY DESIGN: every rank writes
+        # its own shard, so the journal legitimately records this rank's
+        # save (cross-rank symmetry is a sync/collective contract, not a
+        # durability one)
+        journal.record(  # metricslint: disable=guarded-telemetry-emit
+            "checkpoint.save", label=type(metric).__name__, step=step,
+            rank=rank, world=world, bytes=len(payload),
+        )
     if keep_last is not None and rank == 0:
-        prune_checkpoints(directory, keep_last)
+        pruned = prune_checkpoints(directory, keep_last)
+        if pruned:
+            registry_of(metric).inc("checkpoint", "pruned_steps", by=len(pruned))
+            if journal.ACTIVE:
+                # retention runs on rank 0 only by design — the event mirrors
+                # the actual filesystem mutation, which is rank-asymmetric
+                journal.record(  # metricslint: disable=guarded-telemetry-emit
+                    "checkpoint.prune", label=type(metric).__name__,
+                    steps=",".join(map(str, pruned)),
+                )
     return path
 
 
@@ -962,14 +1004,26 @@ def load_checkpoint(
         _validate_shard(metric, shard)
     if len(shards) > 1:
         _validate_fold(metric, shards)
+    from metrics_tpu.observability import journal
+    from metrics_tpu.observability.registry import registry_of
+
     if not shards:
         # scale-up surplus rank: fresh defaults, fresh counters — this rank
         # contributes only data it accumulates from now on
         metric.reset()
-        return metric
-    _apply_replace(metric, shards[0])
-    for shard in shards[1:]:
-        _apply_merge(metric, shard)
+    else:
+        _apply_replace(metric, shards[0])
+        for shard in shards[1:]:
+            _apply_merge(metric, shard)
+    registry_of(metric).inc("checkpoint", "loads")
+    if journal.ACTIVE:
+        # per-rank by design: elastic resume assigns each rank its own
+        # shard stride, so the load event records this rank's fold
+        journal.record(  # metricslint: disable=guarded-telemetry-emit
+            "checkpoint.load", label=type(metric).__name__, step=_step,
+            rank=rank, world=world, shards=len(shards),
+            checkpoint_world=ckpt_world,
+        )
     return metric
 
 
@@ -1097,6 +1151,9 @@ class MetricCheckpointer:
 
     def snapshot(self) -> str:
         """Take one snapshot now (also the periodic/exit-flush path)."""
+        from metrics_tpu.observability.registry import registry_of
+
+        registry_of(self.metric).inc("checkpoint", "auto_snapshots")
         path = save_checkpoint(
             self.metric,
             self.directory,

@@ -44,23 +44,22 @@ from metrics_tpu.core.metric import (
     Metric,
     _copy_state_value,
     _raise_on_catbuffer_overflow,
+    _reset_compiled_for_copy,
 )
+from metrics_tpu.observability import journal
+from metrics_tpu.observability.registry import registry_of
 from metrics_tpu.parallel.async_sync import (
     drain_round,
     launch_round,
-    new_sync_stats,
     resolve_round,
 )
 from metrics_tpu.parallel.health import FUSED_KEY_SEP as _FUSED_KEY_SEP
 from metrics_tpu.utils.data import is_traced
 from metrics_tpu.utils.exceptions import MetricsTPUUserError, StaleSyncError, SyncError
+from metrics_tpu.observability.diagnostics import warn_once
 from metrics_tpu.utils.prints import rank_zero_warn
 
 
-#: classes already warned about a statically-detected grouping hazard (the
-#: declared update_identity promises a side-effect-free update, but the
-#: metricslint report shows an undeclared latch) — warn once per class
-_static_hazard_warned: set = set()
 
 
 def _static_grouping_hazards(m: "Metric") -> List[str]:
@@ -190,6 +189,17 @@ class MetricCollection(dict):
     aliases, re-linked on restore) — and resume elastically at a different
     world size; :meth:`checkpointer` snapshots transparently every N
     ``update``/``forward`` calls (``docs/checkpointing.md``).
+
+    **Observability.** One :meth:`telemetry` call returns the unified
+    stats snapshot for the collection AND every member — compile + sync +
+    checkpoint + health counters under one schema, with delta mode and
+    JSON-lines / Prometheus exporters (:meth:`compile_stats` /
+    :meth:`sync_stats` remain as views over the same registry). The event
+    journal (``metrics_tpu.observability``) records collection-level sync
+    rounds, compute-group formation/detach and compiled fused dispatches
+    alongside the member events, and exports a per-rank
+    Chrome-trace/Perfetto timeline with the overlapped-sync background
+    lane on its own track (``docs/observability.md``).
 
     Args:
         metrics: one Metric, a list/tuple of Metrics, or a dict name->Metric.
@@ -410,15 +420,14 @@ class MetricCollection(dict):
                 # provably latches an undeclared attribute: grouping would
                 # leave siblings with stale latches. Keep it solo (results
                 # stay correct, the dedup is lost) and say why, once.
-                if type(m) not in _static_hazard_warned:
-                    _static_hazard_warned.add(type(m))
-                    rank_zero_warn(
-                        f"{type(m).__name__} declares update_identity() but is "
-                        "excluded from compute groups: " + "; ".join(hazards[:3])
-                        + ". Declare the attribute(s) in _group_shared_attrs "
-                        "(or with add_state) to restore grouping.",
-                        UserWarning,
-                    )
+                warn_once(
+                    ("group-static-hazard", type(m)),
+                    f"{type(m).__name__} declares update_identity() but is "
+                    "excluded from compute groups: " + "; ".join(hazards[:3])
+                    + ". Declare the attribute(s) in _group_shared_attrs "
+                    "(or with add_state) to restore grouping.",
+                    UserWarning,
+                )
                 continue
             key = (ident, m.state_fingerprint()) + self._sync_config_key(m)
             if key not in buckets:
@@ -538,6 +547,11 @@ class MetricCollection(dict):
         for m in metrics:
             object.__setattr__(m, "_compute_group", group)
         self._relink_group(group)
+        if journal.ACTIVE:
+            journal.record(
+                "group.form", label=type(metrics[0]).__name__,
+                members=len(metrics), keys=",".join(k for k, _ in sg),
+            )
 
     def _relink_group(self, group: _ComputeGroup, source: Optional[Metric] = None) -> None:
         """Point every member's state leaves at ``source``'s objects (zero
@@ -592,6 +606,11 @@ class MetricCollection(dict):
         for m in members:
             object.__setattr__(m, "_compute_group", None)
             m._state = {k: _copy_state_value(v) for k, v in m._state.items()}
+        if journal.ACTIVE:
+            journal.record(
+                "group.detach", label="MetricCollection",
+                members=len(members), reason="dispatch-failure",
+            )
         self._groups_stale = True
 
     # ---------------- forward / update / compute ----------------
@@ -641,7 +660,11 @@ class MetricCollection(dict):
     def _compiled_dispatcher(self) -> CompiledDispatcher:
         disp = self.__dict__.get("_compiled")
         if disp is None:
-            disp = CompiledDispatcher("MetricCollection")
+            # bound to the telemetry registry's "compile" domain, exactly
+            # like Metric's — one storage behind compile_stats()/telemetry()
+            disp = CompiledDispatcher(
+                "MetricCollection", registry_of(self).domain("compile")
+            )
             self.__dict__["_compiled"] = disp
         return disp
 
@@ -653,15 +676,31 @@ class MetricCollection(dict):
         every eligible compute-group leader together, plus the compiled group
         ``forward`` programs); member entries count their own solo programs
         and record per-instance fallback reasons. See
-        :meth:`Metric.compile_stats`.
+        :meth:`Metric.compile_stats` (like it, a view over the unified
+        telemetry registry — prefer :meth:`telemetry` in new code).
         """
-        disp = self.__dict__.get("_compiled")
-        coll = (
-            disp.stats()
-            if disp is not None
-            else {"traces": 0, "dispatches": 0, "cache_hits": 0, "steps_seen": 0, "fallback": None}
-        )
+        from metrics_tpu.core.compiled import compile_stats_view
+
+        coll = compile_stats_view(registry_of(self).domain("compile"))
         return {"collection": coll, "members": {k: m.compile_stats() for k, m in super().items()}}
+
+    def telemetry(self, delta: bool = False) -> Dict[str, Any]:
+        """The unified observability snapshot for the collection and every
+        member: ``{"collection": {schema, compile, sync, checkpoint, health,
+        process}, "members": {key: <member telemetry>}}`` — one call returns
+        the compile + sync + checkpoint + health counters for everything
+        this collection runs (see :meth:`Metric.telemetry`;
+        ``delta=True`` returns per-counter change since the previous delta
+        call on each registry)."""
+        from metrics_tpu.core.compiled import compile_stats_view
+
+        reg = registry_of(self)
+        extra = {"compile": compile_stats_view(reg.domain("compile"))}
+        coll = reg.delta(extra) if delta else reg.snapshot(extra)
+        return {
+            "collection": coll,
+            "members": {k: m.telemetry(delta=delta) for k, m in super().items()},
+        }
 
     def _compiled_units(self) -> List[Tuple[str, Metric, Tuple[Metric, ...]]]:
         """One ``(key, leader, members)`` triple per dispatch unit — solo
@@ -1095,6 +1134,13 @@ class MetricCollection(dict):
         self._cancel_overlap()
         return self.__dict__
 
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        self.__dict__.update(state)
+        # a copy/unpickle carries a fresh, unbound dispatcher — drop it and
+        # zero the registry's compile domain so the lazily re-created one
+        # binds to clean counters (mirrors Metric.__setstate__)
+        _reset_compiled_for_copy(self)
+
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         # an in-flight round's future cannot deepcopy: drain symmetrically
         # first (fold-back preserves every member's accumulation)
@@ -1252,11 +1298,19 @@ class MetricCollection(dict):
                     on_error=on_error, timeout=timeout, relaunch=not blocking
                 )
                 return
-            except SyncError:
+            except SyncError as err:
                 modes = [
                     on_error if on_error is not None else getattr(m, "sync_on_error", "raise")
                     for m in self.values()
                 ]
+                registry_of(self).count_error(
+                    err, degraded=not all(mode == "raise" for mode in modes)
+                )
+                if journal.ACTIVE:
+                    journal.record(
+                        "health.failure", label="MetricCollection",
+                        error=type(err).__name__, phase="resolve",
+                    )
                 if all(mode == "raise" for mode in modes):
                     raise  # every member's local accumulation was restored first
                 # degradation requested somewhere: every member holds its
@@ -1283,11 +1337,19 @@ class MetricCollection(dict):
             try:
                 self._sync_fused(timeout=timeout)
                 return
-            except SyncError:
+            except SyncError as err:
                 modes = [
                     on_error if on_error is not None else getattr(m, "sync_on_error", "raise")
                     for m in self.values()
                 ]
+                registry_of(self).count_error(
+                    err, degraded=not all(mode == "raise" for mode in modes)
+                )
+                if journal.ACTIVE:
+                    journal.record(
+                        "health.failure", label="MetricCollection",
+                        error=type(err).__name__, phase="fused",
+                    )
                 if all(mode == "raise" for mode in modes):
                     raise  # nothing was synced: all-or-nothing holds trivially
                 # degradation requested somewhere: re-run per member so each
@@ -1452,19 +1514,16 @@ class MetricCollection(dict):
     # ---------------- overlapped (non-blocking) collection sync ----------------
 
     def _sync_stats_dict(self) -> Dict[str, Any]:
-        stats = self.__dict__.get("_sync_stats")
-        if stats is None:
-            stats = new_sync_stats()
-            self.__dict__["_sync_stats"] = stats
-        return stats
+        return registry_of(self).domain("sync")
 
     def sync_stats(self) -> Dict[str, Any]:
         """Overlapped-sync observability, mirroring :meth:`compile_stats`:
         the ``collection`` entry counts collection-level rounds (one round =
         one fused header + bucketed payload for ALL members), member entries
-        count their own standalone rounds. See :meth:`Metric.sync_stats`."""
-        stats = self.__dict__.get("_sync_stats")
-        coll = dict(new_sync_stats() if stats is None else stats)
+        count their own standalone rounds. See :meth:`Metric.sync_stats`
+        (like it, a view over the unified telemetry registry — prefer
+        :meth:`telemetry` in new code)."""
+        coll = dict(registry_of(self).domain("sync"))
         return {"collection": coll, "members": {k: m.sync_stats() for k, m in super().items()}}
 
     def _overlap_eligible(self, distributed_available: Optional[Callable]) -> bool:
@@ -1613,6 +1672,14 @@ class MetricCollection(dict):
         any_stale = any(
             getattr(x, "_update_count", 0) > counts[key] for key, x, _g in members
         )
+        if journal.ACTIVE:
+            journal.record(
+                "sync.resolve", label="MetricCollection",
+                sync_epoch=round_.epoch, stale=any_stale, policy=policy,
+                verdict=("stale:" + policy) if any_stale else "fresh",
+                wait_s=wait_s, gather_s=round_.gather_s,
+                gather_start=round_.gather_started,
+            )
         if any_stale:
             stats["stale_resolves"] += 1
             if policy == "fresh":

@@ -46,12 +46,14 @@ counts, ``check_finite`` poison flags, CatBuffer appends and overflow
 latches, dtype persistence and compute-group dispatch all behave
 bit-identically (``tests/bases/test_compiled_update.py``).
 """
+import itertools
 import os
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 
-from metrics_tpu.utils.prints import rank_zero_warn
+from metrics_tpu.observability import diagnostics, journal
 
 #: Env escape hatch: set to 0/false/off to disable compiled eager dispatch
 #: process-wide (every update/forward then runs the per-op eager path).
@@ -78,6 +80,12 @@ def dispatch_program(disp: "CompiledDispatcher", kind: str, prog: Callable, stat
     ordinary copy, exactly what the eager path pays; the global warning
     filters are deliberately left untouched).
     """
+    # journal gate read once: when the recorder is off the step path pays
+    # this one attribute read — no clock calls, no allocation
+    active = journal.ACTIVE
+    if active:
+        t0 = time.monotonic()
+        traces0 = disp.traces
     try:
         out = prog(states, dynamic)
     except Exception as err:  # noqa: BLE001 - recover to eager when state survived
@@ -91,6 +99,17 @@ def dispatch_program(disp: "CompiledDispatcher", kind: str, prog: Callable, stat
         )
         return False, None
     disp.note_dispatch()
+    if active:
+        now = time.monotonic()
+        if disp.traces > traces0:
+            journal.record(
+                "compiled.trace", label=disp.label, step=disp.steps_seen,
+                op=kind, traces=disp.traces,
+            )
+        journal.record(
+            "compiled.dispatch", label=disp.label, step=disp.steps_seen,
+            op=kind, dur_s=now - t0,
+        )
     return True, out
 
 
@@ -122,6 +141,21 @@ def trace_storm_threshold() -> int:
     a probe + compile instead of a cache hit, which is strictly worse than
     eager, and the per-key program cache would otherwise grow without bound."""
     return 4 * trace_warn_threshold()
+
+
+def compile_stats_view(stats: Dict[str, Any]) -> Dict[str, Any]:
+    """The public ``compile_stats()`` shape, derived from the registry's
+    ``compile`` domain (``observability/registry.py``): raw counters pass
+    through, ``cache_hits`` is computed, an empty fallback map reads as
+    ``None`` (API compatibility with the historical dict bookkeeping)."""
+    fallback = stats.get("fallback")
+    return {
+        "traces": stats.get("traces", 0),
+        "dispatches": stats.get("dispatches", 0),
+        "cache_hits": max(stats.get("dispatches", 0) - stats.get("traces", 0), 0),
+        "steps_seen": stats.get("steps_seen", 0),
+        "fallback": dict(fallback) if fallback else None,
+    }
 
 
 class _Dynamic:
@@ -351,44 +385,81 @@ class CompiledDispatcher:
 
     __slots__ = (
         "label",
-        "traces",
-        "dispatches",
-        "steps_seen",
-        "fallback",
+        "uid",
+        "_stats",
         "_programs",
         "_probed",
-        "_warned_fallback",
-        "_warned_traces",
+        "_churn_warned",
     )
 
-    def __init__(self, label: str) -> None:
+    #: monotonically-increasing dispatcher ids: the warn_once dedupe keys
+    #: must survive this dispatcher's garbage collection (an ``id(self)``
+    #: key can be REUSED by a later allocation, silently eating a brand-new
+    #: instance's first warning)
+    _uid_counter = itertools.count()
+
+    def __init__(self, label: str, stats: Optional[Dict[str, Any]] = None) -> None:
         self.label = label
-        self.traces = 0
-        self.dispatches = 0
-        self.steps_seen = 0
-        self.fallback: Dict[str, str] = {}
+        self.uid = next(CompiledDispatcher._uid_counter)
+        self._churn_warned = False
+        # counter storage: the owner's telemetry-registry "compile" domain
+        # when bound (Metric._compiled_dispatcher passes it), else a private
+        # dict of the same shape — compile_stats() is a VIEW over this dict
+        # either way (one storage, no hand-maintained copies)
+        self._stats = stats if stats is not None else {}
+        self._stats.setdefault("traces", 0)
+        self._stats.setdefault("dispatches", 0)
+        self._stats.setdefault("steps_seen", 0)
+        if not isinstance(self._stats.get("fallback"), dict):
+            self._stats["fallback"] = {}
         self._programs: Dict[Any, Any] = {}
         self._probed: set = set()
-        self._warned_fallback = False
-        self._warned_traces = False
+
+    # counter shims: every counting site reads/writes the registry dict
+    @property
+    def traces(self) -> int:
+        return self._stats["traces"]
+
+    @traces.setter
+    def traces(self, v: int) -> None:
+        self._stats["traces"] = v
+
+    @property
+    def dispatches(self) -> int:
+        return self._stats["dispatches"]
+
+    @dispatches.setter
+    def dispatches(self, v: int) -> None:
+        self._stats["dispatches"] = v
+
+    @property
+    def steps_seen(self) -> int:
+        return self._stats["steps_seen"]
+
+    @steps_seen.setter
+    def steps_seen(self, v: int) -> None:
+        self._stats["steps_seen"] = v
+
+    @property
+    def fallback(self) -> Dict[str, str]:
+        return self._stats["fallback"]
 
     def stats(self) -> Dict[str, Any]:
-        return {
-            "traces": self.traces,
-            "dispatches": self.dispatches,
-            "cache_hits": max(self.dispatches - self.traces, 0),
-            "steps_seen": self.steps_seen,
-            "fallback": dict(self.fallback) or None,
-        }
+        return compile_stats_view(self._stats)
 
     def mark_fallback(self, kind: str, reason: str, warn: bool = True) -> None:
         """Permanently route ``kind`` dispatches to eager for this instance."""
         if kind in self.fallback:
             return
         self.fallback[kind] = reason
-        if warn and not self._warned_fallback:
-            self._warned_fallback = True
-            rank_zero_warn(
+        if journal.ACTIVE:
+            journal.record(
+                "compiled.fallback", label=self.label, step=self.steps_seen,
+                op=kind, reason=reason,
+            )
+        if warn:
+            diagnostics.warn_once(
+                ("compiled-fallback", self.uid),
                 f"{self.label}: compiled eager {kind} disabled for this instance — "
                 f"{reason}. The per-op eager path (bit-identical, slower) is used "
                 f"instead; escape hatches: {COMPILED_UPDATE_ENV}=0 process-wide or "
@@ -421,9 +492,13 @@ class CompiledDispatcher:
 
     def note_dispatch(self) -> None:
         self.dispatches += 1
-        if not self._warned_traces and self.traces >= trace_warn_threshold():
-            self._warned_traces = True
-            rank_zero_warn(
+        # the per-instance bool keeps the warn_once lock + dedupe-set probe
+        # off the hot step path once the threshold has been crossed (this
+        # method runs on EVERY compiled dispatch)
+        if not self._churn_warned and self.traces >= trace_warn_threshold():
+            self._churn_warned = True
+            diagnostics.warn_once(
+                ("compiled-trace-churn", self.uid),
                 f"{self.label}: the compiled eager path retraced {self.traces} times — "
                 "churn in the call signature (ragged last batches, a state whose shape "
                 "grows every step, or a python-scalar argument whose value changes per "

@@ -44,6 +44,7 @@ from jax import Array
 from metrics_tpu.core.cat_buffer import CatBuffer
 from metrics_tpu.core.compiled import (
     CompiledDispatcher,
+    compile_stats_view,
     compiled_update_enabled,
     compiled_warmup,
     consult_static,
@@ -52,11 +53,12 @@ from metrics_tpu.core.compiled import (
     rebuild_call,
     split_call,
 )
+from metrics_tpu.observability import journal
+from metrics_tpu.observability.registry import registry_of
 from metrics_tpu.parallel.async_sync import (
     AsyncSyncRound,
     drain_round,
     launch_round,
-    new_sync_stats,
     resolve_round,
     validate_staleness_policy,
 )
@@ -284,6 +286,20 @@ def _merge_leaf_divergences(name: str, a: Any, b: Any, fx: Any, declared: Any) -
     return out
 
 
+def _reset_compiled_for_copy(m: "Metric") -> None:
+    """A copy/unpickle must start with a fresh compiled dispatcher (cached
+    programs close over the ORIGINAL instance) — drop the carried-over
+    dispatcher and zero the telemetry registry's ``compile`` domain so the
+    lazily re-created dispatcher binds to clean counters describing the new
+    instance alone."""
+    m.__dict__.pop("_compiled", None)
+    reg = m.__dict__.get("_telemetry")
+    if reg is not None:
+        dom = reg.domain("compile")
+        dom.clear()
+        dom.update({"traces": 0, "dispatches": 0, "steps_seen": 0, "fallback": {}})
+
+
 class _ComputeGroup:
     """Shared-state link between metrics of a ``MetricCollection`` compute
     group (see ``collections.py``): every member's ``_state`` values alias
@@ -425,6 +441,22 @@ class Metric:
     cache hits and the fallback reason. Ragged tail batches simply retrace
     once per new shape (cached across epochs); sustained shape churn emits
     a one-time diagnostic. See ``docs/performance.md``.
+
+    **Observability.** :meth:`telemetry` returns the unified, schema'd
+    stats snapshot — the :meth:`compile_stats` and :meth:`sync_stats`
+    counters (both retained as API-compatible views over the same
+    registry) plus checkpoint save/load/prune/refusal counts, typed
+    sync-failure and degradation counts, and process-wide health facts —
+    with ``delta=True`` for poll loops and JSON-lines / Prometheus
+    exporters in ``metrics_tpu.observability``. The off-by-default event
+    journal (``observability.enable()``) additionally records every
+    compiled dispatch, sync round (launch/resolve/drain with
+    ``sync_epoch`` and staleness verdict), health transition, checkpoint
+    and compute-group change as timestamped per-rank events, exportable as
+    a Chrome-trace/Perfetto timeline
+    (``observability.export_chrome_trace``); ``observability.on_event``
+    wires degradation events into fleet loggers. See
+    ``docs/observability.md``.
     """
 
     #: Whether the metric value is differentiable w.r.t. its float inputs.
@@ -761,6 +793,12 @@ class Metric:
             return
         group.members[:] = [m for m in group.members if m is not self]
         object.__setattr__(self, "_compute_group", None)
+        if journal.ACTIVE:
+            journal.record(
+                "group.detach", label=type(self).__name__,
+                step=getattr(self, "_update_count", -1),
+                remaining=len(group.members),
+            )
         # private copies of mutable containers; array leaves are immutable
         # and stay shared until the next reassignment (true copy-on-write).
         # The shared arrays now have an out-of-group alias, so neither side
@@ -1052,11 +1090,24 @@ class Metric:
         ``"raise"``, and otherwise marks the degradation (so a paired
         ``unsync()`` is a tolerated no-op) and warns."""
         self._cache = None
+        registry_of(self).count_error(err, degraded=on_error != "raise")
+        if journal.ACTIVE:
+            journal.record(
+                "health.failure", label=type(self).__name__,
+                step=getattr(self, "_update_count", -1),
+                error=type(err).__name__, on_error=on_error,
+            )
         if on_error == "raise":
             raise err
         # swallowed: mark the degradation so a paired unsync() is a
         # tolerated no-op instead of an "already un-synced" crash
         self._sync_degraded = True
+        if journal.ACTIVE:
+            journal.record(
+                "degrade.local", label=type(self).__name__,
+                step=getattr(self, "_update_count", -1),
+                error=type(err).__name__, on_error=on_error,
+            )
         if isinstance(err, NonFiniteStateError) and self._local_state_poisoned():
             # degradation promises a degraded-but-CORRECT local result;
             # when this rank's own state is the poisoned one, its local
@@ -1161,12 +1212,14 @@ class Metric:
     # overlapped (non-blocking, double-buffered) sync
     # ------------------------------------------------------------------
 
+    def _telemetry_registry(self) -> Any:
+        """This instance's unified stats registry
+        (``observability/registry.py``) — the one storage behind
+        :meth:`compile_stats`, :meth:`sync_stats` and :meth:`telemetry`."""
+        return registry_of(self)
+
     def _sync_stats_dict(self) -> Dict[str, Any]:
-        stats = self.__dict__.get("_sync_stats")
-        if stats is None:
-            stats = new_sync_stats()
-            object.__setattr__(self, "_sync_stats", stats)
-        return stats
+        return registry_of(self).domain("sync")
 
     def sync_stats(self) -> Dict[str, Any]:
         """Observability for the overlapped sync path (mirrors
@@ -1179,9 +1232,32 @@ class Metric:
         long resolves actually blocked) and ``overlap_saved_s`` (their
         difference: the collective cost hidden behind the training step,
         i.e. what the same syncs would have stalled in blocking mode).
+
+        .. note:: a view over the ``sync`` domain of the unified telemetry
+           registry; kept for API compatibility — new code should prefer
+           :meth:`telemetry`, which returns the same counters alongside the
+           compile/checkpoint/health domains.
         """
-        stats = self.__dict__.get("_sync_stats")
-        return dict(new_sync_stats() if stats is None else stats)
+        return dict(registry_of(self).domain("sync"))
+
+    def telemetry(self, delta: bool = False) -> Dict[str, Any]:
+        """The unified, schema'd observability snapshot for this metric:
+        ``compile`` (the :meth:`compile_stats` counters), ``sync`` (the
+        :meth:`sync_stats` counters), ``checkpoint`` (saves / loads /
+        pruned steps / refused / auto-snapshots), ``health`` (typed
+        sync-failure and degradation counts) and ``process`` (watchdog
+        fires and the live channel-suspect latch), under one
+        ``metrics_tpu.telemetry.v1`` schema.
+
+        ``delta=True`` returns the numeric change since the previous
+        ``telemetry(delta=True)`` call (the poll-loop form; the first call
+        deltas against zero). Export with
+        :func:`metrics_tpu.observability.telemetry_jsonl` /
+        :func:`~metrics_tpu.observability.telemetry_prometheus`.
+        """
+        reg = registry_of(self)
+        extra = {"compile": self.compile_stats()}
+        return reg.delta(extra) if delta else reg.snapshot(extra)
 
     def _overlap_refusal(self) -> Optional[str]:
         """Why this metric cannot overlap its sync (``None`` = it can)."""
@@ -1315,6 +1391,15 @@ class Metric:
         stats["resolve_wait_s"] += wait_s
         stats["overlap_saved_s"] += max(0.0, round_.gather_s - wait_s)
         policy = getattr(self, "staleness_policy", "snapshot")
+        if journal.ACTIVE:
+            journal.record(
+                "sync.resolve", label=type(self).__name__,
+                step=getattr(self, "_update_count", -1),
+                sync_epoch=round_.epoch, stale=stale, policy=policy,
+                verdict=("stale:" + policy) if stale else "fresh",
+                wait_s=wait_s, gather_s=round_.gather_s,
+                gather_start=round_.gather_started,
+            )
         if stale:
             stats["stale_resolves"] += 1
             if policy == "fresh":
@@ -1462,7 +1547,11 @@ class Metric:
     def _compiled_dispatcher(self) -> CompiledDispatcher:
         disp = self.__dict__.get("_compiled")
         if disp is None:
-            disp = CompiledDispatcher(type(self).__name__)
+            # the dispatcher counts straight into the telemetry registry's
+            # "compile" domain: compile_stats()/telemetry() read ONE storage
+            disp = CompiledDispatcher(
+                type(self).__name__, registry_of(self).domain("compile")
+            )
             object.__setattr__(self, "_compiled", disp)
         return disp
 
@@ -1479,17 +1568,12 @@ class Metric:
         instance was routed to the per-op eager path, or is ``None`` while
         the compiled path is (still) available. Surfaced per metric in
         ``bench.py`` diagnostics (config 11).
+
+        .. note:: a view over the ``compile`` domain of the unified
+           telemetry registry (``observability/registry.py``); kept for API
+           compatibility — new code should prefer :meth:`telemetry`.
         """
-        disp = self.__dict__.get("_compiled")
-        if disp is None:
-            return {
-                "traces": 0,
-                "dispatches": 0,
-                "cache_hits": 0,
-                "steps_seen": 0,
-                "fallback": None,
-            }
-        return disp.stats()
+        return compile_stats_view(registry_of(self).domain("compile"))
 
     def _nested_metric_attrs(self) -> List[str]:
         """Instance attributes holding other Metric objects (one container
@@ -1883,9 +1967,8 @@ class Metric:
             object.__setattr__(new, k, deepcopy(v, memo))
         # deepcopy may hand immutable array leaves back by reference, so the
         # clone and the original can share state buffers — neither may donate
-        # them until it has re-copied (the clone also starts with a fresh
-        # CompiledDispatcher via CompiledDispatcher.__deepcopy__: cached
-        # programs close over the original instance)
+        # them until it has re-copied
+        _reset_compiled_for_copy(new)
         object.__setattr__(new, "_donation_ready", False)
         object.__setattr__(self, "_donation_ready", False)
         return new
@@ -2145,6 +2228,7 @@ class Metric:
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
+        _reset_compiled_for_copy(self)
         self.__dict__["_donation_ready"] = False
         self._state = apply_to_collection(self._state, (np.ndarray,), jnp.asarray)
         self._defaults = apply_to_collection(self._defaults, (np.ndarray,), jnp.asarray)

@@ -1,0 +1,77 @@
+"""metrics_tpu.observability — unified telemetry for the metric runtime.
+
+Three layers, one instrumentation surface (``docs/observability.md``):
+
+- :mod:`~metrics_tpu.observability.journal` — the structured event journal:
+  an off-by-default, lock-free per-thread ring recorder of typed runtime
+  events (compiled trace/dispatch/fallback, sync launch/resolve/drain with
+  ``sync_epoch`` and staleness verdict, health-word failures, watchdog
+  fires, channel-suspect latches, degradations, checkpoint save/load/prune,
+  compute-group form/detach), each carrying monotonic time, rank and step;
+  plus the :func:`on_event` subscriber hook for fleet loggers.
+- :mod:`~metrics_tpu.observability.trace_export` — renders the journal as
+  a Chrome-trace/Perfetto JSON timeline: one process per rank, the
+  overlapped-sync background lane as its own track, rounds correlated
+  across ranks by ``sync_epoch``.
+- :mod:`~metrics_tpu.observability.registry` — the unified stats registry
+  behind ``Metric.telemetry()`` / ``MetricCollection.telemetry()``:
+  compile + sync + checkpoint + health counters in one schema'd snapshot
+  (``compile_stats()``/``sync_stats()`` are views over it), with
+  delta-since-last-call and JSON-lines / Prometheus exporters.
+
+Quick start::
+
+    from metrics_tpu import observability as obs
+
+    obs.enable()                        # start recording
+    ... training loop ...
+    obs.export_chrome_trace("trace.json")   # open in ui.perfetto.dev
+    print(obs.telemetry_prometheus(metric.telemetry()))
+
+    sub = obs.on_event(print, classes=("health", "degrade"))
+    ... sub.close()
+"""
+from metrics_tpu.observability import diagnostics, journal, registry, trace_export
+from metrics_tpu.observability.diagnostics import diag, warn_once
+from metrics_tpu.observability.journal import (
+    EVENT_KINDS,
+    Event,
+    clear,
+    disable,
+    enable,
+    enabled,
+    events,
+    on_event,
+    record,
+)
+from metrics_tpu.observability.registry import (
+    TELEMETRY_SCHEMA,
+    StatsRegistry,
+    telemetry_jsonl,
+    telemetry_prometheus,
+)
+from metrics_tpu.observability.trace_export import chrome_trace, export_chrome_trace
+
+__all__ = [
+    "EVENT_KINDS",
+    "TELEMETRY_SCHEMA",
+    "Event",
+    "StatsRegistry",
+    "chrome_trace",
+    "clear",
+    "diag",
+    "diagnostics",
+    "disable",
+    "enable",
+    "enabled",
+    "events",
+    "export_chrome_trace",
+    "journal",
+    "on_event",
+    "record",
+    "registry",
+    "telemetry_jsonl",
+    "telemetry_prometheus",
+    "trace_export",
+    "warn_once",
+]

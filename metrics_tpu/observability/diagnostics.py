@@ -1,0 +1,83 @@
+"""One-time diagnostics with a tested dedupe key.
+
+The runtime grew three separate once-only warning mechanisms — the compiled
+path's per-instance ``_warned_fallback``/``_warned_traces`` flags
+(``core/compiled.py``), the compute-group planner's per-class
+``_static_hazard_warned`` set (``core/collections.py``), and ``bench.py``'s
+ad-hoc ``_diag`` JSON lines. All three now route through this one helper
+(keys ``("compiled-fallback", uid)`` / ``("compiled-trace-churn", uid)`` /
+``("group-static-hazard", cls)``; ``bench._diag`` delegates to :func:`diag`):
+
+- :func:`warn_once` — emit a warning exactly once per *dedupe key* (any
+  hashable; conventionally a tuple like ``("compiled-fallback", id(disp))``
+  so per-instance and per-class once-semantics are both just key choices);
+- :func:`diag` — one structured JSON diagnostic line on stderr (the bench
+  convention, importable so scripts and bench paths stop re-defining it);
+- :func:`reset` — clear the dedupe memory (tests).
+
+``warn_once`` itself never touches the event journal — call sites with a
+journal-worthy fact record their own typed event alongside the warning
+(``compiled.py``'s fallback path journals ``compiled.fallback`` at the same
+site), so the warning text and the machine-readable event stay independent.
+"""
+import json
+import sys
+import threading
+import warnings
+from typing import Any, Hashable, Optional
+
+from metrics_tpu.utils.prints import rank_zero_warn
+
+__all__ = ["diag", "reset", "seen", "warn_once"]
+
+_seen: set = set()
+_lock = threading.Lock()
+
+
+def warn_once(
+    key: Hashable,
+    message: str,
+    category: type = UserWarning,
+    *,
+    every_rank: bool = False,
+    stacklevel: int = 3,
+) -> bool:
+    """Warn exactly once per ``key`` (process-wide). Returns ``True`` when
+    this call emitted (the first for its key), ``False`` on dedupe.
+
+    ``every_rank=True`` warns on every process (corruption-class messages);
+    the default gates on rank zero like :func:`rank_zero_warn`. The dedupe
+    is keyed BEFORE the rank gate, so non-zero ranks still consume their
+    key — a later identical warning never pops up on one rank only.
+    """
+    with _lock:
+        if key in _seen:
+            return False
+        _seen.add(key)
+    if every_rank:
+        warnings.warn(message, category, stacklevel=stacklevel)
+    else:
+        rank_zero_warn(message, category, stacklevel=stacklevel + 1)
+    return True
+
+
+def seen(key: Hashable) -> bool:
+    """Has ``key``'s one-time diagnostic already fired?"""
+    with _lock:
+        return key in _seen
+
+
+def reset(key: Optional[Hashable] = None) -> None:
+    """Forget one dedupe key (or all of them) — test isolation."""
+    with _lock:
+        if key is None:
+            _seen.clear()
+        else:
+            _seen.discard(key)
+
+
+def diag(**kv: Any) -> None:
+    """One structured JSON diagnostic line on stderr — the ``bench.py``
+    convention (``{"diagnostic": {...}}``), shared so bench paths and
+    scripts stop re-defining it."""
+    print(json.dumps({"diagnostic": kv}, default=str), file=sys.stderr)

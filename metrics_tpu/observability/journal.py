@@ -1,0 +1,357 @@
+"""Structured event journal — the one place runtime facts become visible.
+
+The runtime now does most of its interesting work where the user can't see
+it: compiled dispatches with silent fallback ladders (``core/compiled.py``),
+background sync rounds resolving on dedicated threads
+(``parallel/async_sync.py``), watchdogs and channel-suspect latches
+(``parallel/health.py``), auto-checkpoint cadences (``core/checkpoint.py``).
+Overlap is only trustworthy when the runtime can *track* the interleaving of
+compute and collectives (PAPERS.md "T3: Transparent Tracking & Triggering
+for Fine-grained Overlap of Compute & Collectives") — this module is that
+tracking layer: every subsystem emits typed events into one journal, and the
+trace exporter (``observability/trace_export.py``) renders them as a
+cross-rank timeline.
+
+Design constraints (the hot-path contract, asserted by
+``tests/observability``):
+
+- **Off by default, ~free when off.** The recorder is a module-level
+  :data:`ACTIVE` flag; every hot emission site guards with
+  ``if journal.ACTIVE:`` *before* building any arguments, so the disabled
+  compiled step path pays one attribute read — no allocation, no lock
+  (bench config 13 asserts <2 % overhead even with the recorder ON).
+- **Lock-free recording.** Each thread writes to its own pre-allocated ring
+  buffer (``capacity`` events, oldest overwritten); the only lock is taken
+  once per thread, at buffer registration. Background sync lanes and
+  watchdog workers therefore record without ever contending with the step
+  loop.
+- **Never from traced code.** :func:`record` raises if called while a jax
+  trace is ambient — an event emitted at trace time would fire once per
+  compilation instead of once per step, silently skewing per-rank journals.
+  Asserted, not assumed: emission sites live on the host side of every
+  dispatch.
+- **Per-rank symmetric.** Emission sites in ``parallel/`` hot paths are
+  guard-free (no "emit only on this rank" branches) — enforced statically
+  by metricslint's ``guarded-telemetry-emit`` rule — so LockstepWorld ranks
+  record identical event sequences (``tests/observability``).
+
+Every event carries monotonic time, rank, and step, plus kind-specific
+fields (see :data:`EVENT_KINDS` — the catalog is documented in
+``docs/observability.md``). Subscribers (:func:`on_event`) receive events
+synchronously at the emission site — the seam for wiring degradation events
+into fleet loggers — and keep emission active even while the ring buffer
+recorder is disabled.
+"""
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "EVENT_KINDS",
+    "ACTIVE",
+    "Event",
+    "Subscription",
+    "clear",
+    "disable",
+    "enable",
+    "enabled",
+    "events",
+    "on_event",
+    "record",
+    "set_rank_provider",
+]
+
+#: Event-kind catalog: ``<class>.<what>`` — the class (prefix before the
+#: dot) is the subscriber-filter unit (``on_event(cb, classes=("health",))``).
+EVENT_KINDS: Dict[str, str] = {
+    # ---- compiled eager hot path (core/compiled.py) ----------------------
+    "compiled.trace": "an XLA (re)trace of a compiled update/forward program",
+    "compiled.dispatch": "one compiled donated-state dispatch (the step span)",
+    "compiled.fallback": "an instance permanently routed to eager, with reason",
+    # ---- host sync (parallel/sync.py, parallel/async_sync.py) ------------
+    "sync.gather": "a blocking health-checked host sync issuing collectives",
+    "sync.plan": "a bucketed sync plan built (plan-cache miss)",
+    "sync.launch": "a non-blocking round launched onto the background lane",
+    "sync.resolve": "an overlapped round consumed, with staleness verdict",
+    "sync.drain": "a round drained and discarded (the symmetric cancel)",
+    # ---- health / fault tolerance (parallel/health.py) -------------------
+    "health.failure": "a typed SyncError observed at a sync boundary",
+    "health.watchdog": "a sync watchdog fired on a stuck collective",
+    "health.channel_suspect": "the process-wide channel-suspect latch set",
+    "health.channel_reset": "the channel-suspect latch cleared",
+    # ---- degradation (Metric._handle_sync_failure) -----------------------
+    "degrade.local": "a sync failure swallowed under on_error='local'/'warn'",
+    # ---- checkpointing (core/checkpoint.py) ------------------------------
+    "checkpoint.save": "one rank shard atomically written",
+    "checkpoint.load": "a snapshot restored (elastic folds included)",
+    "checkpoint.prune": "retention removed old snapshot steps",
+    "checkpoint.refused": "a snapshot refused (in-flight round / synced state)",
+    # ---- compute groups (core/collections.py) ----------------------------
+    "group.form": "a compute group formed (members share one state + update)",
+    "group.detach": "a member copy-on-write detached from its group",
+}
+
+#: Fast emission gate — ``True`` while the ring-buffer recorder is enabled
+#: OR any subscriber is registered. Hot call sites read this attribute
+#: before building event arguments; when ``False`` an emission site costs
+#: one module-attribute read and nothing else.
+ACTIVE: bool = False
+
+_DEFAULT_CAPACITY = 65536
+
+_enabled = False
+_capacity = _DEFAULT_CAPACITY
+_subscribers: List["Subscription"] = []
+
+_registry_lock = threading.Lock()
+_buffers: List["_ThreadBuffer"] = []
+_generation = 0
+_tls = threading.local()
+
+
+class Event:
+    """One journal entry: monotonic time, rank, step, kind, label, fields."""
+
+    __slots__ = ("ts", "rank", "step", "kind", "label", "fields")
+
+    def __init__(self, ts: float, rank: int, step: int, kind: str, label: str,
+                 fields: Dict[str, Any]) -> None:
+        self.ts = ts
+        self.rank = rank
+        self.step = step
+        self.kind = kind
+        self.label = label
+        self.fields = fields
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return (
+            f"Event({self.kind!r}, label={self.label!r}, rank={self.rank}, "
+            f"step={self.step}, ts={self.ts:.6f}, {self.fields})"
+        )
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {
+            "ts": self.ts,
+            "rank": self.rank,
+            "step": self.step,
+            "kind": self.kind,
+            "label": self.label,
+            **self.fields,
+        }
+
+
+class _ThreadBuffer:
+    """One thread's pre-allocated event ring. The owning thread is the only
+    writer (``slots[n % capacity] = ev; n += 1`` — no lock, no allocation
+    beyond the Event itself); readers snapshot after quiescing."""
+
+    __slots__ = ("name", "slots", "n", "gen")
+
+    def __init__(self, name: str, capacity: int, gen: int) -> None:
+        self.name = name
+        self.slots: List[Optional[Event]] = [None] * capacity
+        self.n = 0
+        self.gen = gen
+
+    def snapshot(self) -> List[Event]:
+        n, cap = self.n, len(self.slots)
+        if n <= cap:
+            return [e for e in self.slots[:n] if e is not None]
+        start = n % cap
+        ordered = self.slots[start:] + self.slots[:start]
+        return [e for e in ordered if e is not None]
+
+
+def _default_rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # pragma: no cover - jax always importable here
+        return 0
+
+
+#: Rank provider seam: production reads ``jax.process_index()``; simulated
+#: multi-rank worlds (thread-per-rank harnesses) install their thread-local
+#: rank identity via :func:`set_rank_provider` so background-lane events
+#: attribute to the fake rank that launched them.
+_rank_provider: Callable[[], int] = _default_rank
+
+
+def set_rank_provider(fn: Optional[Callable[[], int]]) -> Callable[[], int]:
+    """Install a rank provider (``None`` restores the default); returns the
+    previous one so harnesses can restore it."""
+    global _rank_provider
+    prev = _rank_provider
+    _rank_provider = _default_rank if fn is None else fn
+    return prev
+
+
+def _refresh_active() -> None:
+    global ACTIVE
+    ACTIVE = _enabled or bool(_subscribers)
+
+
+def enable(capacity: Optional[int] = None) -> None:
+    """Turn the ring-buffer recorder on (idempotent). ``capacity`` sets the
+    per-thread ring size (default 65536 events); changing it clears existing
+    buffers."""
+    global _enabled, _capacity
+    if capacity is not None and capacity != _capacity:
+        _capacity = int(capacity)
+        clear()
+    _enabled = True
+    _refresh_active()
+
+
+def disable() -> None:
+    """Turn the recorder off. Already-recorded events remain readable via
+    :func:`events` until :func:`clear`; registered subscribers keep
+    receiving events (they hold :data:`ACTIVE` up on their own)."""
+    global _enabled
+    _enabled = False
+    _refresh_active()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def clear() -> None:
+    """Drop all recorded events (every thread's ring)."""
+    global _generation
+    with _registry_lock:
+        _buffers.clear()
+        # stale thread-local buffers (other threads') re-register lazily:
+        # their generation no longer matches, so the next record() on each
+        # thread allocates a fresh ring
+        _generation += 1
+
+
+def _thread_buffer() -> _ThreadBuffer:
+    buf = getattr(_tls, "buffer", None)
+    if buf is None or buf.gen != _generation:
+        buf = _ThreadBuffer(threading.current_thread().name, _capacity, _generation)
+        with _registry_lock:
+            _buffers.append(buf)
+        _tls.buffer = buf
+    return buf
+
+
+class Subscription:
+    """Handle for one :func:`on_event` subscriber; ``close()`` detaches."""
+
+    __slots__ = ("callback", "classes", "_closed")
+
+    def __init__(self, callback: Callable[[Event], Any],
+                 classes: Optional[frozenset]) -> None:
+        self.callback = callback
+        self.classes = classes
+        self._closed = False
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            _subscribers.remove(self)
+        except ValueError:
+            pass
+        _refresh_active()
+
+    def __enter__(self) -> "Subscription":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
+
+
+def on_event(
+    callback: Callable[[Event], Any],
+    classes: Optional[Iterable[str]] = None,
+) -> Subscription:
+    """Subscribe ``callback`` to journal events, synchronously at emission.
+
+    ``classes`` filters by event class (the ``<class>`` prefix of the kind,
+    e.g. ``("health", "degrade")`` wires just the fault/degradation stream
+    into a fleet logger); ``None`` receives everything. Registering a
+    subscriber activates emission even while the ring-buffer recorder is
+    disabled. The callback runs on the emitting thread (background sync
+    lanes included) and must be cheap and non-raising — exceptions are
+    swallowed so observability can never take down the step loop.
+
+    Returns a :class:`Subscription`; call ``.close()`` (or use it as a
+    context manager) to detach.
+    """
+    sub = Subscription(callback, None if classes is None else frozenset(classes))
+    _subscribers.append(sub)
+    _refresh_active()
+    return sub
+
+
+def record(kind: str, label: str = "", step: int = -1, **fields: Any) -> None:
+    """Emit one event. No-op while :data:`ACTIVE` is off (hot sites guard on
+    the flag themselves to skip argument construction too).
+
+    Raises ``RuntimeError`` when called under an ambient jax trace: a
+    trace-time emission would fire per compilation, not per step, skewing
+    per-rank journals — the "never emit from inside traced code" contract,
+    asserted here rather than assumed at the call sites.
+    """
+    if not ACTIVE:
+        return
+    from metrics_tpu.utils.checks import _tracing_active
+
+    if _tracing_active():
+        raise RuntimeError(
+            f"observability.journal.record({kind!r}) called from inside traced "
+            "code — events must be emitted on the host side of a dispatch, "
+            "never at trace time (the emission would replay per compilation, "
+            "not per step)."
+        )
+    ev = Event(time.monotonic(), _rank_provider(), step, kind, label, fields)
+    if _enabled:
+        buf = _thread_buffer()
+        buf.slots[buf.n % len(buf.slots)] = ev
+        buf.n += 1
+    if _subscribers:
+        cls = kind.partition(".")[0]
+        for sub in list(_subscribers):
+            if sub.classes is None or cls in sub.classes:
+                try:
+                    sub.callback(ev)
+                except Exception:  # noqa: BLE001 - observability never raises into the step
+                    pass
+
+
+def events(
+    kinds: Optional[Iterable[str]] = None,
+    rank: Optional[int] = None,
+) -> List[Event]:
+    """All recorded events, merged across threads, sorted by monotonic time.
+
+    ``kinds`` filters by exact kind or by class prefix (``"sync"`` matches
+    every ``sync.*`` event); ``rank`` filters by the recorded rank. Read
+    after quiescing the workload (rings are single-writer, reader-snapshot).
+    """
+    with _registry_lock:
+        bufs = list(_buffers)
+    out: List[Event] = []
+    for buf in bufs:
+        out.extend(buf.snapshot())
+    if kinds is not None:
+        wanted = set(kinds)
+        out = [
+            e for e in out
+            if e.kind in wanted or e.kind.partition(".")[0] in wanted
+        ]
+    if rank is not None:
+        out = [e for e in out if e.rank == rank]
+    out.sort(key=lambda e: e.ts)
+    return out
+
+
+def event_sequence(rank: Optional[int] = None) -> List[Tuple[str, str]]:
+    """The ``(kind, label)`` sequence of recorded events in time order — the
+    compact form the cross-rank symmetry tests compare."""
+    return [(e.kind, e.label) for e in events(rank=rank)]

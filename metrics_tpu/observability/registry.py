@@ -1,0 +1,291 @@
+"""Unified per-instance stats registry — one schema'd ``telemetry()`` surface.
+
+Before this module, diagnostics were hand-maintained in four disjoint
+places: ``compile_stats()`` (dispatcher attribute counters),
+``sync_stats()`` (two copies of the same dict bookkeeping, on ``Metric``
+AND ``MetricCollection``), checkpoint paths (uncounted), and the health
+layer (uncounted process-global latches). :class:`StatsRegistry` is the one
+storage those surfaces now share:
+
+- the ``sync`` domain IS the dict ``Metric._sync_stats_dict()`` /
+  ``MetricCollection._sync_stats_dict()`` mutate — ``sync_stats()`` is a
+  view over it;
+- the ``compile`` domain IS the dict ``core.compiled.CompiledDispatcher``
+  counts into — ``compile_stats()`` is a view over it;
+- the ``checkpoint`` and ``health`` domains are new counters bumped by
+  ``core/checkpoint.py`` and the sync failure/degradation ladder;
+- process-wide facts (watchdog fires, the channel-suspect latch) live in
+  the module-level :data:`PROCESS` counters, snapshotted into every
+  ``telemetry()`` call under the ``process`` key.
+
+``telemetry()`` (on ``Metric`` and ``MetricCollection``) returns the full
+schema'd snapshot; ``telemetry(delta=True)`` returns the numeric change
+since the previous delta call (the poll-loop form). :func:`telemetry_jsonl`
+and :func:`telemetry_prometheus` are the export encoders (JSON-lines for
+log shippers, Prometheus text exposition for scrapers).
+"""
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "TELEMETRY_SCHEMA",
+    "PROCESS",
+    "StatsRegistry",
+    "process_snapshot",
+    "registry_of",
+    "telemetry_jsonl",
+    "telemetry_prometheus",
+]
+
+#: Schema identifier stamped into every snapshot (bump on layout changes).
+TELEMETRY_SCHEMA = "metrics_tpu.telemetry.v1"
+
+#: Storage-backed domains and their counter defaults. ``compile`` is listed
+#: for schema completeness but its storage lives with the instance's
+#: ``CompiledDispatcher`` (created on first dispatch); ``Metric.telemetry``
+#: splices it in from ``compile_stats()``.
+DOMAIN_DEFAULTS: Dict[str, Dict[str, Any]] = {
+    "compile": {
+        "traces": 0,
+        "dispatches": 0,
+        "cache_hits": 0,
+        "steps_seen": 0,
+        "fallback": None,
+    },
+    "sync": {
+        "launched": 0,
+        "resolved": 0,
+        "stale_resolves": 0,
+        "degraded": 0,
+        "cancelled": 0,
+        "served_local": 0,
+        "gather_s": 0.0,
+        "resolve_wait_s": 0.0,
+        "overlap_saved_s": 0.0,
+    },
+    "checkpoint": {
+        "saves": 0,
+        "loads": 0,
+        "pruned_steps": 0,
+        "refused": 0,
+        "auto_snapshots": 0,
+    },
+    "health": {
+        "sync_failures": 0,
+        "degraded": 0,
+        "errors": {},  # typed SyncError class name -> count
+    },
+}
+
+#: Process-wide counters (no instance owns a watchdog): bumped by
+#: ``parallel/health.py``, snapshotted under the ``process`` key of every
+#: ``telemetry()`` call.
+PROCESS: Dict[str, int] = {
+    "watchdog_fired": 0,
+    "channel_suspect_latched": 0,
+    "channel_resets": 0,
+}
+_PROCESS_LOCK = threading.Lock()
+
+
+def bump_process(key: str, by: int = 1) -> None:
+    with _PROCESS_LOCK:
+        PROCESS[key] = PROCESS.get(key, 0) + by
+
+
+def process_snapshot() -> Dict[str, Any]:
+    """Current process-wide health facts (the live suspect flag included)."""
+    from metrics_tpu.parallel.health import channel_is_suspect
+
+    with _PROCESS_LOCK:
+        snap: Dict[str, Any] = dict(PROCESS)
+    snap["channel_suspect"] = bool(channel_is_suspect())
+    return snap
+
+
+def _deep_copy_counters(d: Dict[str, Any]) -> Dict[str, Any]:
+    return {k: (dict(v) if isinstance(v, dict) else v) for k, v in d.items()}
+
+
+def _numeric_delta(now: Any, before: Any) -> Any:
+    """Recursive numeric difference (non-numeric leaves pass through as
+    their current value)."""
+    if isinstance(now, dict):
+        before = before if isinstance(before, dict) else {}
+        return {k: _numeric_delta(v, before.get(k)) for k, v in now.items()}
+    if isinstance(now, bool) or not isinstance(now, (int, float)):
+        return now
+    prev = before if isinstance(before, (int, float)) and not isinstance(before, bool) else 0
+    return now - prev
+
+
+class StatsRegistry:
+    """Counter storage for one ``Metric`` / ``MetricCollection`` instance.
+
+    Domains are plain dicts (picklable, deepcopy-able with their owner);
+    callers mutate them in place through :meth:`domain` — the same live-dict
+    convention the historical ``_sync_stats`` bookkeeping used, so the
+    counting sites read identically while the storage is unified.
+    """
+
+    __slots__ = ("label", "_domains", "_last")
+
+    def __init__(self, label: str) -> None:
+        self.label = label
+        self._domains: Dict[str, Dict[str, Any]] = {}
+        self._last: Optional[Dict[str, Any]] = None
+
+    def domain(self, name: str) -> Dict[str, Any]:
+        """The live counter dict for ``name`` (created from the schema
+        defaults on first use). Mutations through the returned reference ARE
+        the registry's state."""
+        d = self._domains.get(name)
+        if d is None:
+            d = _deep_copy_counters(DOMAIN_DEFAULTS.get(name, {}))
+            self._domains[name] = d
+        return d
+
+    def inc(self, name: str, key: str, by: float = 1) -> None:
+        d = self.domain(name)
+        d[key] = d.get(key, 0) + by
+
+    def count_error(self, err: BaseException, degraded: bool) -> None:
+        """The health-domain bump shared by every sync-failure path."""
+        h = self.domain("health")
+        h["sync_failures"] += 1
+        errors = h.setdefault("errors", {})
+        cls = type(err).__name__
+        errors[cls] = errors.get(cls, 0) + 1
+        if degraded:
+            h["degraded"] += 1
+
+    def snapshot(self, extra: Optional[Dict[str, Dict[str, Any]]] = None) -> Dict[str, Any]:
+        """The full schema'd telemetry snapshot for this instance. ``extra``
+        splices in provider-backed domains (``compile`` from the dispatcher)
+        so the registry itself stays closure-free and picklable."""
+        snap: Dict[str, Any] = {"schema": TELEMETRY_SCHEMA, "label": self.label}
+        domains = dict(extra or {})
+        for name in DOMAIN_DEFAULTS:
+            if name not in domains:
+                domains[name] = self.domain(name)
+        for name, counters in domains.items():
+            snap[name] = _deep_copy_counters(counters)
+        snap["process"] = process_snapshot()
+        return snap
+
+    def delta(self, extra: Optional[Dict[str, Dict[str, Any]]] = None) -> Dict[str, Any]:
+        """Numeric change since the previous ``delta()`` call (first call
+        deltas against zero). Non-numeric entries (labels, fallback reasons,
+        the live suspect flag) carry their current value."""
+        now = self.snapshot(extra)
+        before = self._last or {}
+        self._last = now
+        out = {k: _numeric_delta(v, before.get(k)) for k, v in now.items()}
+        out["schema"] = TELEMETRY_SCHEMA
+        out["label"] = self.label
+        return out
+
+    def __deepcopy__(self, memo: dict) -> "StatsRegistry":
+        new = StatsRegistry(self.label)
+        new._domains = {k: _deep_copy_counters(v) for k, v in self._domains.items()}
+        return new
+
+
+def registry_of(obj: Any) -> StatsRegistry:
+    """The instance's registry (created on first use). Works for ``Metric``
+    (custom ``__setattr__`` routed around via ``object.__setattr__``) and
+    ``MetricCollection`` alike."""
+    reg = obj.__dict__.get("_telemetry")
+    if reg is None:
+        reg = StatsRegistry(type(obj).__name__)
+        object.__setattr__(obj, "_telemetry", reg)
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# export encoders
+# ---------------------------------------------------------------------------
+
+
+def _flatten(prefix: str, value: Any, out: Dict[str, Any]) -> None:
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _flatten(f"{prefix}_{k}" if prefix else str(k), v, out)
+    else:
+        out[prefix] = value
+
+
+def telemetry_jsonl(snapshot: Dict[str, Any]) -> str:
+    """Encode one telemetry snapshot as JSON-lines: one line per domain
+    (collection snapshots recurse into members, each member its own block
+    of lines with a ``member`` field)."""
+    lines: List[str] = []
+
+    def emit(snap: Dict[str, Any], member: Optional[str] = None) -> None:
+        label = snap.get("label", "")
+        for domain, counters in snap.items():
+            if domain in ("schema", "label") or not isinstance(counters, dict):
+                continue
+            row: Dict[str, Any] = {
+                "schema": snap.get("schema", TELEMETRY_SCHEMA),
+                "label": label,
+                "domain": domain,
+            }
+            if member is not None:
+                row["member"] = member
+            row.update(counters)
+            lines.append(json.dumps(row, sort_keys=True, default=str))
+
+    if "collection" in snapshot and "members" in snapshot:
+        emit(snapshot["collection"])
+        for key, member_snap in snapshot["members"].items():
+            emit(member_snap, member=key)
+    else:
+        emit(snapshot)
+    return "\n".join(lines)
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def telemetry_prometheus(snapshot: Dict[str, Any]) -> str:
+    """Encode one telemetry snapshot as Prometheus text exposition.
+
+    Numeric counters become ``metrics_tpu_<domain>_<counter>`` samples with
+    ``label=""`` (and ``member=""`` for collection members); booleans encode
+    as 0/1 gauges; strings are skipped (they ride the JSON-lines form).
+    """
+    samples: List[str] = []
+    typed: set = set()
+
+    def emit(snap: Dict[str, Any], member: Optional[str] = None) -> None:
+        label = _prom_escape(str(snap.get("label", "")))
+        for domain, counters in snap.items():
+            if domain in ("schema", "label") or not isinstance(counters, dict):
+                continue
+            flat: Dict[str, Any] = {}
+            _flatten("", counters, flat)
+            for key, value in sorted(flat.items()):
+                if isinstance(value, bool):
+                    value = int(value)
+                if not isinstance(value, (int, float)):
+                    continue
+                name = f"metrics_tpu_{domain}_{key}".replace("-", "_").replace(".", "_")
+                if name not in typed:
+                    typed.add(name)
+                    kind = "gauge" if domain == "process" else "counter"
+                    samples.append(f"# TYPE {name} {kind}")
+                tags = f'label="{label}"'
+                if member is not None:
+                    tags += f',member="{_prom_escape(member)}"'
+                samples.append(f"{name}{{{tags}}} {value}")
+
+    if "collection" in snapshot and "members" in snapshot:
+        emit(snapshot["collection"])
+        for key, member_snap in snapshot["members"].items():
+            emit(member_snap, member=key)
+    else:
+        emit(snapshot)
+    return "\n".join(samples) + "\n"

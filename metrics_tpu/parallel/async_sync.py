@@ -73,6 +73,7 @@ from concurrent.futures import wait as _futures_wait
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Iterator, Optional
 
+from metrics_tpu.observability import journal
 from metrics_tpu.utils.exceptions import SyncTimeoutError
 
 __all__ = [
@@ -118,17 +119,17 @@ def new_sync_stats() -> Dict[str, Any]:
     - ``overlap_saved_s`` — ``gather_s − resolve_wait_s`` accumulated per
       round: the collective time hidden behind the training step, i.e. what
       the same syncs would have stalled the host in blocking mode.
+
+    The counter schema is owned by the unified telemetry registry
+    (``observability/registry.py`` ``DOMAIN_DEFAULTS["sync"]``) — this
+    helper returns a fresh copy of it, so ``sync_stats()`` and
+    ``telemetry()`` can never disagree on keys.
     """
+    from metrics_tpu.observability.registry import DOMAIN_DEFAULTS
+
     return {
-        "launched": 0,
-        "resolved": 0,
-        "stale_resolves": 0,
-        "degraded": 0,
-        "cancelled": 0,
-        "served_local": 0,
-        "gather_s": 0.0,
-        "resolve_wait_s": 0.0,
-        "overlap_saved_s": 0.0,
+        k: (dict(v) if isinstance(v, dict) else v)
+        for k, v in DOMAIN_DEFAULTS["sync"].items()
     }
 
 
@@ -293,6 +294,7 @@ class AsyncSyncRound:
         "metric_name",
         "future",
         "gather_s",
+        "gather_started",
         "launched_monotonic",
     )
 
@@ -312,6 +314,7 @@ class AsyncSyncRound:
         self.metric_name = metric_name
         self.future: Any = None
         self.gather_s: float = 0.0
+        self.gather_started: float = 0.0
         self.launched_monotonic = time.monotonic()
 
 
@@ -351,6 +354,7 @@ def launch_round(
 
         _IN_ROUND.active = True
         start = time.monotonic()
+        round_.gather_started = start
         try:
             if sync_fn is not None:
                 with sync_channel():
@@ -369,6 +373,11 @@ def launch_round(
             round_.gather_s = time.monotonic() - start
             _IN_ROUND.active = False
 
+    if journal.ACTIVE:
+        journal.record(
+            "sync.launch", label=metric_name, sync_epoch=epoch,
+            update_count=int(update_count),
+        )
     domain = _current_domain()
     future = _get_executor().submit(task)
     round_.future = future
@@ -427,6 +436,8 @@ def drain_round(round_: AsyncSyncRound, timeout: Optional[float] = None) -> None
     loudly, so the liveness failure still surfaces without making the
     cancel path's outcome depend on per-rank timing.
     """
+    if journal.ACTIVE:
+        journal.record("sync.drain", label=round_.metric_name, sync_epoch=round_.epoch)
     try:
         resolve_round(round_, timeout=timeout)
     except Exception:

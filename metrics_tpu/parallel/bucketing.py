@@ -229,6 +229,12 @@ def build_sync_plan(state: Dict[str, Any], reductions: Dict[str, Any]) -> SyncPl
             _plan_stats["hits"] += 1
             return plan
     plan = _classify(state, reductions, key)
+    from metrics_tpu.observability import journal
+
+    if journal.ACTIVE:
+        journal.record(
+            "sync.plan", buckets=plan.n_buckets, cat_leaves=len(plan.cat_leaves),
+        )
     with _PLAN_LOCK:
         _plan_stats["misses"] += 1
         if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:

@@ -87,6 +87,8 @@ from typing import Any, Callable, Dict, Optional, TypeVar
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.observability import journal
+from metrics_tpu.observability.registry import bump_process
 from metrics_tpu.utils.exceptions import (
     NonFiniteStateError,
     StateDivergenceError,
@@ -567,6 +569,9 @@ def verify_health_words(
 # refuses to issue new collectives while the latch is set (degrading cleanly
 # under on_error="local") instead of corrupting silently.
 _channel_suspect = threading.Event()
+#: serializes latch/clear transitions so concurrent markers (watchdog thread
+#: vs background resolve lane) count and journal each episode exactly once
+_suspect_transition_lock = threading.Lock()
 
 
 def channel_is_suspect() -> bool:
@@ -576,17 +581,31 @@ def channel_is_suspect() -> bool:
 
 
 def mark_channel_suspect() -> None:
-    """Latch the suspect flag from outside the watchdog — the async overlap
-    layer (``parallel/async_sync.py``) calls this when an in-flight round's
-    future cannot complete, which means a collective is stuck somewhere on
-    the background lane: exactly the condition the latch exists for."""
-    _channel_suspect.set()
+    """Latch the suspect flag — the one emission site for the transition
+    (the watchdog, and the async overlap layer when an in-flight round's
+    future cannot complete, both land here), so the journal records the
+    latch exactly once per suspect episode. The transition lock makes the
+    check-and-set atomic: a watchdog thread and a background resolve lane
+    latching concurrently must not double-count the episode."""
+    with _suspect_transition_lock:
+        if _channel_suspect.is_set():
+            return
+        _channel_suspect.set()
+    bump_process("channel_suspect_latched")
+    if journal.ACTIVE:
+        journal.record("health.channel_suspect")
 
 
 def reset_channel_health() -> None:
     """Clear the suspect latch — call only after the process group has been
     re-established (or in tests that simulate the channel)."""
-    _channel_suspect.clear()
+    with _suspect_transition_lock:
+        if not _channel_suspect.is_set():
+            return
+        _channel_suspect.clear()
+    bump_process("channel_resets")
+    if journal.ACTIVE:
+        journal.record("health.channel_reset")
 
 
 def call_with_sync_watchdog(
@@ -623,7 +642,10 @@ def call_with_sync_watchdog(
     worker.start()
     worker.join(timeout)
     if worker.is_alive():
-        _channel_suspect.set()
+        bump_process("watchdog_fired")
+        if journal.ACTIVE:
+            journal.record("health.watchdog", label=what, timeout_s=timeout)
+        mark_channel_suspect()
         raise SyncTimeoutError(
             f"{what} did not complete within {timeout:g}s — a peer process is "
             "likely dead or stalled. Raise METRICS_TPU_SYNC_TIMEOUT_S for slow "

@@ -398,8 +398,15 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
     """
     if not jit_distributed_available():
         return {name: host_sync_leaf(value, reductions.get(name)) for name, value in state.items()}
+    from metrics_tpu.observability import journal
     from metrics_tpu.parallel.async_sync import sync_channel
     from metrics_tpu.parallel.health import channel_is_suspect
+
+    if journal.ACTIVE:
+        journal.record(
+            "sync.gather", label=metric_name, sync_epoch=int(sync_epoch),
+            states=len(state), fused=fused,
+        )
 
     if channel_is_suspect():
         from metrics_tpu.utils.exceptions import SyncTimeoutError

@@ -17,6 +17,7 @@ import metrics_tpu.core.collections as coll_mod
 import metrics_tpu.core.metric as metric_mod
 from metrics_tpu import MeanSquaredError, MetricCollection, Precision, Recall
 from metrics_tpu.analysis.runtime import clear_cache, static_probe_verdict
+from metrics_tpu.observability import diagnostics
 from metrics_tpu.utils.exceptions import MetricsTPUUserError
 
 from tests.analysis.runtime_fixtures import (
@@ -159,7 +160,7 @@ def test_planner_groups_clean_identity_classes():
 def test_planner_excludes_statically_refuted_class():
     # the hazard warning fires once per class per process: reset for order-
     # independence (pytest-randomly etc.)
-    coll_mod._static_hazard_warned.discard(GroupableLeaky)
+    diagnostics.reset(("group-static-hazard", GroupableLeaky))
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         mc = MetricCollection({"a": GroupableLeaky(), "b": GroupableLeaky()})

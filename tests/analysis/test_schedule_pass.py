@@ -150,3 +150,73 @@ def test_shipped_parallel_modules_verify():
     src = open(sync_path).read().replace("# metricslint: disable", "# stripped")
     resurfaced = analyze_source(src, sync_path)
     assert any(f.rule == "data-dependent-collective" for f in resurfaced)
+
+
+def test_guarded_emit_fixture_covers_the_rule():
+    owners = by_function(findings_for("violating_guarded_emit.py"))
+    assert owners["rank_gated_emit"] == {"guarded-telemetry-emit"}
+    assert owners["data_gated_emit"] == {"guarded-telemetry-emit"}
+    # wrapping record() in a local helper must not defeat the rule: the
+    # recorder fixpoint propagates through the intra-module call graph
+    assert owners["rank_gated_emit_via_helper"] == {"guarded-telemetry-emit"}
+    # the helper itself has no tainted guard, so it is clean
+    assert "_emit_helper" not in owners
+    # the canonical `if journal.ACTIVE:` hot-path guard is symmetric config
+    assert "active_gated_emit_is_clean" not in owners
+
+
+def test_recorder_calls_are_not_collectives_and_do_not_wash_taint():
+    """record() is known NON-collective: it is never flagged as a collective
+    (no data-dependent-collective finding for a guarded record), and its
+    appearance never WASHES taint — local data threaded past an emission is
+    still local when it later guards a real collective."""
+    src = '''
+def _process_allgather(x, timeout=None):
+    return x
+
+def emit_then_gather(state, x):
+    record("sync.gather", states=len(state))   # emission, NOT a collective
+    n = len(state)
+    record("sync.plan", buckets=n)
+    if n > 0:                                   # still local: record washed nothing
+        return _process_allgather(x)
+    return x
+'''
+    findings = run_schedule_pass(ast.parse(src), "<s>")
+    owners = by_function(findings)
+    # the guarded collective IS flagged; the unguarded emissions are not
+    assert owners["emit_then_gather"] == {"data-dependent-collective"}
+    assert all(
+        f.rule != "guarded-telemetry-emit" or f.line != 7 for f in findings
+    ), "an unguarded record() must never be flagged"
+
+
+def test_emit_only_functions_are_checked():
+    """A function that emits telemetry but no collectives still gets the
+    guard-free check (run_schedule_pass's filter includes RECORDER_CALLS)."""
+    src = '''
+import jax
+
+def emit_only(value):
+    if value.sum() > 0:
+        record("sync.resolve", stale=True)
+'''
+    findings = run_schedule_pass(ast.parse(src), "<s>")
+    assert by_function(findings)["emit_only"] == {"guarded-telemetry-emit"}
+
+
+def test_shipped_package_emission_sites_are_guard_free():
+    """Every journal emission the runtime ships (core/ + parallel/) passes
+    the guarded-telemetry-emit rule — the per-rank-by-design checkpoint
+    events carry explicit commented suppressions, and stripping those
+    resurfaces the findings (the suppressions are real)."""
+    import metrics_tpu
+
+    pkg = os.path.dirname(metrics_tpu.__file__)
+    findings, errors = analyze_paths([pkg])
+    assert not errors
+    assert [f for f in findings if f.rule == "guarded-telemetry-emit"] == []
+    ckpt_path = os.path.join(pkg, "core", "checkpoint.py")
+    src = open(ckpt_path).read().replace("# metricslint: disable", "# stripped")
+    resurfaced = analyze_source(src, ckpt_path)
+    assert any(f.rule == "guarded-telemetry-emit" for f in resurfaced)

@@ -1,0 +1,21 @@
+"""Shared isolation for the observability suite: every test starts with the
+recorder disabled, an empty journal, no subscribers, and the default rank
+provider — and leaves the process the same way."""
+import pytest
+
+from metrics_tpu.observability import journal
+
+
+@pytest.fixture(autouse=True)
+def _fresh_journal():
+    journal.disable()
+    journal.clear()
+    journal._subscribers.clear()
+    journal._refresh_active()
+    prev = journal.set_rank_provider(None)
+    yield
+    journal.disable()
+    journal.clear()
+    journal._subscribers.clear()
+    journal._refresh_active()
+    journal.set_rank_provider(prev)

@@ -1,0 +1,107 @@
+"""One-time diagnostics: the dedupe key contract (tested per the ISSUE-8
+satellite), rank gating, and the shared bench `diag` line."""
+import json
+import warnings
+
+import pytest
+
+from metrics_tpu.observability import diagnostics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_dedupe():
+    diagnostics.reset()
+    yield
+    diagnostics.reset()
+
+
+def _caught(fn):
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        emitted = fn()
+    return emitted, caught
+
+
+def test_warn_once_dedupes_on_the_key():
+    emitted1, caught1 = _caught(lambda: diagnostics.warn_once(("k", 1), "first"))
+    emitted2, caught2 = _caught(lambda: diagnostics.warn_once(("k", 1), "second"))
+    assert emitted1 is True and len(caught1) == 1 and "first" in str(caught1[0].message)
+    assert emitted2 is False and caught2 == []  # same key: deduped
+    assert diagnostics.seen(("k", 1))
+
+
+def test_different_keys_warn_independently():
+    e1, c1 = _caught(lambda: diagnostics.warn_once(("k", 1), "one"))
+    e2, c2 = _caught(lambda: diagnostics.warn_once(("k", 2), "two"))
+    assert e1 and e2 and len(c1) == len(c2) == 1
+
+
+def test_key_is_any_hashable_tuple():
+    # the conventions the runtime uses: per-instance and per-class keys
+    assert diagnostics.warn_once(("compiled-fallback", 12345), "m1")
+    assert diagnostics.warn_once(("compiled-fallback", 67890), "m2")
+    assert not diagnostics.warn_once(("compiled-fallback", 12345), "m1 again")
+
+
+def test_reset_single_key():
+    diagnostics.warn_once("a", "x")
+    diagnostics.warn_once("b", "x")
+    diagnostics.reset("a")
+    assert not diagnostics.seen("a") and diagnostics.seen("b")
+
+
+def test_category_passes_through():
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        diagnostics.warn_once("cat-key", "msg", RuntimeWarning)
+    assert caught and issubclass(caught[0].category, RuntimeWarning)
+
+
+def test_every_rank_warns_off_rank_zero(monkeypatch):
+    import metrics_tpu.utils.prints as prints
+
+    monkeypatch.setattr(prints, "_process_index", lambda: 3)
+    # rank-zero-gated: non-zero rank emits nothing but consumes the key
+    emitted, caught = _caught(lambda: diagnostics.warn_once("rz", "gated"))
+    assert emitted is True and caught == []
+    # every_rank: non-zero rank still warns
+    emitted, caught = _caught(
+        lambda: diagnostics.warn_once("er", "loud", every_rank=True)
+    )
+    assert emitted and len(caught) == 1
+
+
+def test_compiled_fallback_warns_once_per_instance():
+    """The consumer contract: the compiled path's fallback diagnostic is
+    keyed per dispatcher instance through this module."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.core.metric import Metric
+
+    class _L(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("t", jnp.zeros(()), dist_reduce_fx="sum")
+            self.tags = []
+
+        def update(self, x):
+            self.tags.append(1)  # metricslint: disable=undeclared-state
+            self.t = self.t + jnp.sum(x)
+
+        def compute(self):
+            return self.t
+
+    m = _L(compiled_update=True)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for _ in range(3):
+            m.update(jnp.ones((2,)))
+    fallback_warns = [c for c in caught if "compiled eager" in str(c.message)]
+    assert len(fallback_warns) == 1
+
+
+def test_diag_emits_bench_convention_line(capsys):
+    diagnostics.diag(config=13, note="hello", value=1.5)
+    err = capsys.readouterr().err.strip()
+    parsed = json.loads(err)
+    assert parsed == {"diagnostic": {"config": 13, "note": "hello", "value": 1.5}}

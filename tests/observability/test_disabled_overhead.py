@@ -1,0 +1,68 @@
+"""The off-by-default contract, asserted: with the recorder disabled, the
+compiled step path records zero events and performs ZERO allocations inside
+the observability package — the emission sites' ``if journal.ACTIVE:``
+guards are one module-attribute read, nothing else."""
+import os
+import tracemalloc
+
+import jax.numpy as jnp
+import numpy as np
+
+import metrics_tpu.observability as obs_pkg
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability import journal
+
+OBS_DIR = os.path.dirname(obs_pkg.__file__)
+
+
+class _Sum(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+def test_disabled_recorder_zero_events_zero_allocations():
+    assert not journal.enabled() and journal.ACTIVE is False
+    m = _Sum(compiled_update=True)
+    x = jnp.asarray(np.ones((8,), np.float32))
+    for _ in range(3):
+        m.update(x)  # warm: trace once, settle caches
+
+    tracemalloc.start(25)
+    try:
+        before = tracemalloc.take_snapshot()
+        for _ in range(50):
+            m.update(x)
+        after = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+
+    assert m.compile_stats()["dispatches"] == 53  # the compiled path ran
+    assert journal.events() == []                 # zero events
+    stats = after.compare_to(before, "filename")
+    obs_allocs = [
+        s for s in stats
+        if s.size_diff > 0 and any(
+            frame.filename.startswith(OBS_DIR) for frame in s.traceback
+        )
+    ]
+    assert obs_allocs == [], [
+        (s.traceback[0].filename, s.size_diff) for s in obs_allocs
+    ]
+
+
+def test_enabled_recorder_does_record_the_same_loop():
+    """Control for the zero-allocation assertion: the SAME loop with the
+    recorder on does record (the disabled test isn't vacuous)."""
+    journal.enable()
+    m = _Sum(compiled_update=True)
+    x = jnp.asarray(np.ones((8,), np.float32))
+    for _ in range(5):
+        m.update(x)
+    assert len(journal.events(kinds=("compiled.dispatch",))) == 5

@@ -1,0 +1,191 @@
+"""Event-journal unit suite: recording, filtering, subscribers, the ring,
+the rank seam, and the never-from-traced-code assertion."""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.observability import journal
+
+
+def test_disabled_recorder_records_nothing():
+    journal.record("sync.gather", label="m")
+    assert journal.events() == []
+    assert journal.ACTIVE is False
+
+
+def test_enable_record_clear():
+    journal.enable()
+    journal.record("sync.gather", label="m", sync_epoch=3)
+    journal.record("checkpoint.save", label="m", step=7)
+    evs = journal.events()
+    assert [e.kind for e in evs] == ["sync.gather", "checkpoint.save"]
+    assert evs[0].fields["sync_epoch"] == 3
+    assert evs[1].step == 7
+    assert evs[0].ts <= evs[1].ts
+    journal.clear()
+    assert journal.events() == []
+
+
+def test_every_emitted_kind_is_catalogued():
+    journal.enable()
+    for kind in journal.EVENT_KINDS:
+        journal.record(kind, label="x")
+    assert len(journal.events()) == len(journal.EVENT_KINDS)
+
+
+def test_kind_and_class_filtering():
+    journal.enable()
+    journal.record("sync.launch", sync_epoch=1)
+    journal.record("sync.resolve", sync_epoch=1)
+    journal.record("health.watchdog")
+    assert [e.kind for e in journal.events(kinds=("sync",))] == [
+        "sync.launch", "sync.resolve",
+    ]
+    assert [e.kind for e in journal.events(kinds=("health.watchdog",))] == [
+        "health.watchdog"
+    ]
+
+
+def test_ring_overwrites_oldest():
+    journal.enable(capacity=8)
+    try:
+        for i in range(20):
+            journal.record("sync.gather", step=i)
+        steps = [e.step for e in journal.events()]
+        assert steps == list(range(12, 20))
+    finally:
+        journal.enable(capacity=None)
+        journal.clear()
+        journal.disable()
+        # restore the default capacity for later tests
+        journal._capacity = journal._DEFAULT_CAPACITY
+
+
+def test_threads_record_into_their_own_rings_and_merge_sorted():
+    journal.enable()
+
+    def emit(tag):
+        for i in range(5):
+            journal.record("sync.gather", label=tag, step=i)
+
+    threads = [threading.Thread(target=emit, args=(f"t{i}",)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    emit("main")
+    evs = journal.events()
+    assert len(evs) == 20
+    assert all(evs[i].ts <= evs[i + 1].ts for i in range(len(evs) - 1))
+    assert {e.label for e in evs} == {"t0", "t1", "t2", "main"}
+
+
+def test_rank_provider_seam():
+    journal.set_rank_provider(lambda: 7)
+    journal.enable()
+    journal.record("sync.gather")
+    assert journal.events()[0].rank == 7
+    assert journal.events(rank=3) == []
+    assert len(journal.events(rank=7)) == 1
+
+
+def test_subscriber_receives_without_recorder():
+    got = []
+    sub = journal.on_event(got.append, classes=("degrade", "health"))
+    try:
+        assert journal.ACTIVE is True  # subscriber keeps emission live
+        journal.record("degrade.local", label="m", error="SyncError")
+        journal.record("sync.gather", label="m")  # filtered out
+        journal.record("health.watchdog")
+        assert [e.kind for e in got] == ["degrade.local", "health.watchdog"]
+        assert journal.events() == []  # ring recorder still off
+    finally:
+        sub.close()
+    assert journal.ACTIVE is False
+    journal.record("degrade.local")
+    assert got[-1].kind == "health.watchdog"  # detached: nothing new
+
+
+def test_subscriber_exceptions_never_propagate():
+    def boom(ev):
+        raise RuntimeError("fleet logger died")
+
+    with journal.on_event(boom):
+        journal.record("health.watchdog")  # must not raise
+
+
+def test_record_inside_trace_raises():
+    journal.enable()
+
+    def traced(x):
+        journal.record("sync.gather", label="m")
+        return x + 1
+
+    with pytest.raises(RuntimeError, match="inside traced code"):
+        jax.jit(traced)(jnp.zeros(()))
+
+
+def test_event_as_dict_roundtrip():
+    journal.enable()
+    journal.record("sync.resolve", label="m", step=2, sync_epoch=4, stale=False)
+    d = journal.events()[0].as_dict()
+    assert d["kind"] == "sync.resolve" and d["sync_epoch"] == 4
+    assert set(d) >= {"ts", "rank", "step", "kind", "label"}
+
+
+def test_compiled_step_loop_journals_dispatches():
+    """The compiled hot path emits one dispatch event per step (plus one
+    trace event per compilation), attributed to the metric label."""
+    from metrics_tpu.core.metric import Metric
+
+    class _Sum(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + jnp.sum(x)
+
+        def compute(self):
+            return self.total
+
+    journal.enable()
+    m = _Sum(compiled_update=True)
+    x = jnp.asarray(np.ones((4,), np.float32))
+    for _ in range(4):
+        m.update(x)
+    kinds = [e.kind for e in journal.events(kinds=("compiled",))]
+    assert kinds.count("compiled.dispatch") == 4
+    assert kinds.count("compiled.trace") == 1
+    ev = journal.events(kinds=("compiled.dispatch",))[0]
+    assert ev.label == "_Sum" and ev.fields["op"] == "update"
+    assert ev.fields["dur_s"] >= 0.0
+
+
+def test_fallback_event_carries_reason():
+    from metrics_tpu.core.metric import Metric
+
+    class _Latch(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+            self.seen = []
+
+        def update(self, x):
+            self.seen.append(1)  # metricslint: disable=undeclared-state
+            self.total = self.total + jnp.sum(x)
+
+        def compute(self):
+            return self.total
+
+    journal.enable()
+    m = _Latch(compiled_update=True)
+    m.update(jnp.ones((2,)))
+    evs = journal.events(kinds=("compiled.fallback",))
+    assert len(evs) == 1
+    assert evs[0].fields["op"] == "update"
+    assert "seen" in evs[0].fields["reason"]
+    assert m.compile_stats()["fallback"]["update"]

@@ -1,0 +1,180 @@
+"""Cross-rank journal symmetry over LockstepWorld: both ranks record the
+SAME event sequence for a blocking and an overlapped sync round (epochs
+aligned), and degradation events land symmetrically. This is the journal's
+core contract — the trace exporter's cross-rank correlation (and the
+``guarded-telemetry-emit`` lint rule backing it) only mean something if the
+per-rank event streams actually line up."""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.parallel.async_sync as async_mod
+import metrics_tpu.parallel.sync as sync_mod
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability import journal
+from metrics_tpu.parallel.bucketing import clear_sync_plan_cache
+from metrics_tpu.parallel.health import reset_channel_health
+from tests.helpers.fake_world import LockstepWorld
+
+WORLD = 2
+
+
+@pytest.fixture(autouse=True)
+def _fresh_channel_and_plans():
+    clear_sync_plan_cache()
+    reset_channel_health()
+    yield
+    clear_sync_plan_cache()
+    reset_channel_health()
+
+
+@pytest.fixture
+def lockstep(monkeypatch):
+    world = LockstepWorld(WORLD)
+    monkeypatch.setattr(jax, "process_count", lambda: world.world)
+    monkeypatch.setattr(sync_mod, "_raw_process_allgather", world.allgather)
+    monkeypatch.setattr(async_mod, "_get_executor", world.executor_for_current_rank)
+    monkeypatch.setattr(async_mod, "_current_domain", world.rank_domain)
+    # journal rank seam: events attribute to the fake rank's thread-local
+    # identity (the background lanes adopt it via the executor initializer)
+    prev = journal.set_rank_provider(lambda: world.rank_domain() or 0)
+    yield world
+    journal.set_rank_provider(prev)
+    world.shutdown_executors()
+
+
+class _Sum(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+def rank_kinds(rank, exclude=("sync.plan",)):
+    """This rank's (kind, epoch) sequence. ``sync.plan`` is excluded: the
+    plan cache is per PROCESS in production, but LockstepWorld's fake ranks
+    share one module-level cache, so which fake rank records the one build
+    is a harness artifact, not a protocol fact."""
+    return [
+        (e.kind, e.fields.get("sync_epoch"))
+        for e in journal.events(rank=rank)
+        if e.kind not in exclude
+    ]
+
+
+def test_blocking_sync_journals_identically_on_both_ranks(lockstep):
+    journal.enable()
+
+    def body(rank):
+        m = _Sum(sync_timeout=0)
+        m.distributed_available_fn = lambda: True
+        m.update(jnp.asarray(float(rank + 1)))
+        m.sync()
+        m.unsync()
+        return float(np.asarray(m.total))
+
+    lockstep.run(body)
+    seq0, seq1 = rank_kinds(0), rank_kinds(1)
+    assert seq0 == seq1
+    assert ("sync.gather", 0) in seq0  # blocking = epoch 0
+
+
+def test_overlapped_round_journals_identically_with_aligned_epochs(lockstep):
+    journal.enable()
+
+    def body(rank):
+        m = _Sum(sync_timeout=0)
+        m.distributed_available_fn = lambda: True
+        m.update(jnp.asarray(float(rank + 1)))
+        m.sync(blocking=False)          # launch
+        m.update(jnp.asarray(10.0))     # post-snapshot delta (stale resolve)
+        # compute() resolves the round (snapshot policy) inside its own
+        # sync_context, which also restores the local accumulation on exit
+        return float(np.asarray(m.compute()))
+
+    values = lockstep.run(body)
+    assert values[0] == values[1] == 3.0  # the consistent world cut
+    seq0, seq1 = rank_kinds(0), rank_kinds(1)
+    assert seq0 == seq1, (seq0, seq1)
+    kinds = [k for k, _ in seq0]
+    assert "sync.launch" in kinds and "sync.resolve" in kinds
+    assert kinds.index("sync.launch") < kinds.index("sync.resolve")
+    # epochs aligned: the launch and resolve of round 1 agree on both ranks
+    launch_epochs = [e for k, e in seq0 if k == "sync.launch"]
+    resolve_epochs = [e for k, e in seq0 if k == "sync.resolve"]
+    assert launch_epochs == resolve_epochs == [1]
+    # the resolve observed the post-snapshot update and said so
+    resolve = [e for e in journal.events(rank=0, kinds=("sync.resolve",))][0]
+    assert resolve.fields["stale"] is True
+    assert resolve.fields["verdict"] == "stale:snapshot"
+    assert resolve.fields["gather_s"] >= 0.0
+
+
+def test_degradation_events_are_symmetric(lockstep):
+    """A symmetric typed failure (strict update-count skew) degrades under
+    on_error='local' with the SAME health.failure + degrade.local events on
+    both ranks."""
+    journal.enable()
+
+    def body(rank):
+        m = _Sum(sync_timeout=0, sync_on_error="local")
+        m.sync_strict_update_count = True
+        m.distributed_available_fn = lambda: True
+        for _ in range(rank + 1):  # rank 1 updates twice: update-count skew
+            m.update(jnp.asarray(1.0))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.sync()
+        assert m._sync_degraded
+        return m.telemetry()["health"]
+
+    healths = lockstep.run(body)
+    for rank in (0, 1):
+        kinds = [k for k, _ in rank_kinds(rank)]
+        assert kinds == ["sync.gather", "health.failure", "degrade.local"], kinds
+    assert rank_kinds(0) == rank_kinds(1)
+    for h in healths:
+        assert h["sync_failures"] == 1 and h["degraded"] == 1
+        assert h["errors"] == {"StateDivergenceError": 1}
+
+
+def test_exported_trace_shows_background_lane_overlapping_step(lockstep):
+    """End-to-end acceptance: export a sync_mode='overlap' run and find the
+    background gather on its own track with sync_epoch-correlated events
+    identical across ranks."""
+    import json
+
+    from metrics_tpu.observability.trace_export import SYNC_LANE, chrome_trace
+
+    journal.enable()
+
+    def body(rank):
+        m = _Sum(sync_timeout=0, sync_mode="overlap")
+        m.distributed_available_fn = lambda: True
+        for interval in range(3):
+            for _ in range(2):
+                m.update(jnp.asarray(float(rank + 1)))
+            m.compute()  # resolve previous round, relaunch
+        m.unsync()  # drain the tail round
+        return m.sync_stats()["resolved"]
+
+    resolved = lockstep.run(body)
+    assert min(resolved) >= 1
+    trace = chrome_trace()
+    json.dumps(trace)  # valid chrome-trace JSON
+    gathers = [t for t in trace["traceEvents"]
+               if t["ph"] == "X" and t["tid"] == SYNC_LANE]
+    assert {t["pid"] for t in gathers} == {0, 1}
+    by_rank = {
+        r: sorted(t["args"]["sync_epoch"] for t in gathers if t["pid"] == r)
+        for r in (0, 1)
+    }
+    assert by_rank[0] == by_rank[1] and by_rank[0]  # correlated epochs

@@ -1,0 +1,197 @@
+"""Unified stats registry: telemetry() snapshots/deltas, the view contract
+for compile_stats()/sync_stats(), checkpoint/health counters, and the
+JSON-lines / Prometheus exporters."""
+import json
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.core.checkpoint import load_checkpoint, save_checkpoint
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability import (
+    journal,
+    telemetry_jsonl,
+    telemetry_prometheus,
+)
+from metrics_tpu.observability.registry import registry_of
+from metrics_tpu.utils.exceptions import MetricsTPUUserError, SyncError
+
+
+class _Sum(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum", persistent=True)
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self):
+        return self.total
+
+
+def test_telemetry_has_every_domain():
+    m = _Sum()
+    m.update(jnp.ones((3,)))
+    t = m.telemetry()
+    assert t["schema"] == "metrics_tpu.telemetry.v1"
+    assert t["label"] == "_Sum"
+    for domain in ("compile", "sync", "checkpoint", "health", "process"):
+        assert domain in t, domain
+    assert t["compile"]["steps_seen"] == 1
+    assert t["sync"]["launched"] == 0
+    assert t["checkpoint"]["saves"] == 0
+    assert t["health"]["sync_failures"] == 0
+    assert "channel_suspect" in t["process"]
+
+
+def test_compile_and_sync_stats_are_views_over_the_registry():
+    m = _Sum(compiled_update=True)
+    for _ in range(3):
+        m.update(jnp.ones((3,)))
+    reg = registry_of(m)
+    # ONE storage: the registry's domains ARE what the views read
+    assert m.compile_stats()["dispatches"] == reg.domain("compile")["dispatches"] == 3
+    reg.domain("sync")["launched"] = 5
+    assert m.sync_stats()["launched"] == 5
+    t = m.telemetry()
+    assert t["compile"]["dispatches"] == 3
+    assert t["compile"]["cache_hits"] == m.compile_stats()["cache_hits"]
+    assert t["sync"]["launched"] == 5
+
+
+def test_telemetry_delta():
+    m = _Sum(compiled_update=True)
+    m.update(jnp.ones((3,)))
+    first = m.telemetry(delta=True)
+    assert first["compile"]["dispatches"] == 1  # first delta is vs zero
+    m.update(jnp.ones((3,)))
+    m.update(jnp.ones((3,)))
+    d = m.telemetry(delta=True)
+    assert d["compile"]["dispatches"] == 2
+    assert d["compile"]["steps_seen"] == 2
+    assert d["sync"]["launched"] == 0
+    assert m.telemetry(delta=True)["compile"]["dispatches"] == 0
+
+
+def test_checkpoint_counters_and_events(tmp_path):
+    journal.enable()
+    m = _Sum()
+    m.update(jnp.ones((4,)))
+    save_checkpoint(m, str(tmp_path), step=0, rank=0, world=1)
+    save_checkpoint(m, str(tmp_path), step=1, rank=0, world=1, keep_last=1)
+    m2 = _Sum()
+    load_checkpoint(m2, str(tmp_path), rank=0, world=1)
+    assert float(np.asarray(m2.total)) == 4.0
+    t = m.telemetry()
+    assert t["checkpoint"]["saves"] == 2
+    assert t["checkpoint"]["pruned_steps"] == 1
+    assert m2.telemetry()["checkpoint"]["loads"] == 1
+    kinds = [e.kind for e in journal.events(kinds=("checkpoint",))]
+    assert kinds == ["checkpoint.save", "checkpoint.save", "checkpoint.prune",
+                     "checkpoint.load"]
+
+
+def test_checkpoint_refusal_counted(tmp_path):
+    journal.enable()
+    m = _Sum()
+    m.update(jnp.ones((4,)))
+    m._is_synced = True
+    with pytest.raises(MetricsTPUUserError, match="currently synced"):
+        save_checkpoint(m, str(tmp_path), rank=0, world=1)
+    assert m.telemetry()["checkpoint"]["refused"] == 1
+    ev = journal.events(kinds=("checkpoint.refused",))[0]
+    assert "synced" in ev.fields["reason"]
+
+
+def test_health_counters_on_degradation():
+    m = _Sum()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m._handle_sync_failure(SyncError("peer died"), "local")
+    h = m.telemetry()["health"]
+    assert h["sync_failures"] == 1
+    assert h["degraded"] == 1
+    assert h["errors"] == {"SyncError": 1}
+    with pytest.raises(SyncError):
+        m._handle_sync_failure(SyncError("again"), "raise")
+    h = m.telemetry()["health"]
+    assert h["sync_failures"] == 2
+    assert h["degraded"] == 1  # raise is not a degradation
+
+
+def test_degradation_events_reach_subscribers():
+    got = []
+    m = _Sum()
+    with journal.on_event(got.append, classes=("degrade",)):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m._handle_sync_failure(SyncError("peer died"), "warn")
+    assert [e.kind for e in got] == ["degrade.local"]
+    assert got[0].fields["error"] == "SyncError"
+
+
+def test_collection_telemetry_one_call():
+    """The acceptance shape: ONE telemetry() call returns compile + sync +
+    checkpoint + health counters for a whole collection."""
+    mc = MetricCollection({"a": _Sum(), "b": _Sum()})
+    mc.update(jnp.ones((3,)))
+    t = mc.telemetry()
+    assert set(t) == {"collection", "members"}
+    for domain in ("compile", "sync", "checkpoint", "health", "process"):
+        assert domain in t["collection"]
+        for member in t["members"].values():
+            assert domain in member
+    assert set(t["members"]) == {"a", "b"}
+    assert t["members"]["a"]["compile"]["steps_seen"] == 1
+
+
+def test_jsonl_export_parses():
+    mc = MetricCollection({"a": _Sum(), "b": _Sum()})
+    mc.update(jnp.ones((3,)))
+    lines = telemetry_jsonl(mc.telemetry()).splitlines()
+    rows = [json.loads(line) for line in lines]
+    assert all(r["schema"] == "metrics_tpu.telemetry.v1" for r in rows)
+    domains = {(r.get("member"), r["domain"]) for r in rows}
+    assert (None, "sync") in domains
+    assert ("a", "compile") in domains and ("b", "health") in domains
+
+
+def test_prometheus_export_shape():
+    m = _Sum(compiled_update=True)
+    m.update(jnp.ones((3,)))
+    text = telemetry_prometheus(m.telemetry())
+    assert "# TYPE metrics_tpu_compile_dispatches counter" in text
+    assert 'metrics_tpu_compile_dispatches{label="_Sum"} 1' in text
+    assert 'metrics_tpu_process_channel_suspect' in text
+    # nested error counters flatten; strings are skipped
+    assert "telemetry.v1" not in text
+
+
+def test_prometheus_collection_member_labels():
+    mc = MetricCollection({"a": _Sum()})
+    mc.update(jnp.ones((3,)))
+    text = telemetry_prometheus(mc.telemetry())
+    assert 'member="a"' in text
+
+
+def test_registry_survives_pickle_and_deepcopy_with_fresh_compile_domain():
+    import copy
+    import pickle
+
+    m = _Sum(compiled_update=True)
+    for _ in range(2):
+        m.update(jnp.ones((3,)))
+    registry_of(m).inc("checkpoint", "saves")
+    for clone in (pickle.loads(pickle.dumps(m)), copy.deepcopy(m)):
+        t = clone.telemetry()
+        # durable counters travel; compiled-program counters reset (the
+        # clone's dispatcher is fresh — programs close over the original)
+        assert t["checkpoint"]["saves"] == 1
+        assert t["compile"]["dispatches"] == 0
+        clone.compiled_update = True
+        clone.update(jnp.ones((3,)))
+        assert clone.telemetry()["compile"]["dispatches"] == 1
+    assert m.telemetry()["compile"]["dispatches"] == 2  # original untouched

@@ -1,0 +1,120 @@
+"""Chrome-trace exporter: structural validity, the background sync lane,
+and cross-rank sync_epoch correlation."""
+import json
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.observability import journal
+from metrics_tpu.observability.trace_export import (
+    STEP_LANE,
+    SYNC_LANE,
+    chrome_trace,
+    export_chrome_trace,
+)
+
+
+def _ev(ts, rank, kind, label="m", step=-1, **fields):
+    return journal.Event(ts, rank, step, kind, label, fields)
+
+
+def test_empty_journal_exports_valid_trace():
+    trace = chrome_trace([])
+    assert trace == {"traceEvents": [], "displayTimeUnit": "ms"}
+    json.dumps(trace)
+
+
+def test_compiled_dispatches_become_duration_events():
+    evs = [
+        _ev(10.000, 0, "compiled.dispatch", "Sum", step=1, op="update", dur_s=0.002),
+        _ev(10.010, 0, "compiled.trace", "Sum", step=1, op="update", traces=1),
+    ]
+    trace = chrome_trace(evs)
+    xs = [t for t in trace["traceEvents"] if t["ph"] == "X"]
+    assert len(xs) == 1
+    assert xs[0]["tid"] == STEP_LANE and xs[0]["pid"] == 0
+    assert abs(xs[0]["dur"] - 2000.0) < 1e-6  # 2 ms in µs
+    assert any(t["ph"] == "i" and "compiled.trace" in t["name"]
+               for t in trace["traceEvents"])
+
+
+def test_overlapped_round_renders_background_lane_with_epoch():
+    """The acceptance look: the background gather is its OWN track (tid 1),
+    overlapping the step lane, correlated across ranks by sync_epoch."""
+    evs = []
+    for rank in (0, 1):
+        evs.append(_ev(100.0, rank, "sync.launch", "Sum", sync_epoch=3,
+                       update_count=5))
+        # step keeps running 100.0..100.1 while the gather rides behind it
+        evs.append(_ev(100.002 + rank * 1e-4, rank, "compiled.dispatch", "Sum",
+                       op="update", dur_s=0.001))
+        evs.append(_ev(100.100, rank, "sync.resolve", "Sum", sync_epoch=3,
+                       stale=False, policy="snapshot", verdict="fresh",
+                       wait_s=0.0001, gather_s=0.05, gather_start=100.001))
+    trace = chrome_trace(evs)
+    gathers = [t for t in trace["traceEvents"]
+               if t["ph"] == "X" and t["tid"] == SYNC_LANE]
+    assert len(gathers) == 2  # one background span per rank
+    assert {t["pid"] for t in gathers} == {0, 1}
+    for g in gathers:
+        assert g["args"]["sync_epoch"] == 3
+        assert abs(g["dur"] - 50_000.0) < 1e-3  # 50 ms gather in µs
+    # the background span OVERLAPS the step lane's dispatch span in time
+    steps = [t for t in trace["traceEvents"]
+             if t["ph"] == "X" and t["tid"] == STEP_LANE and t["pid"] == 0]
+    g0 = next(t for t in gathers if t["pid"] == 0)
+    s0 = steps[0]
+    assert g0["ts"] < s0["ts"] + s0["dur"] and s0["ts"] < g0["ts"] + g0["dur"]
+    # cross-rank correlation: identical epoch args on both ranks' rounds
+    resolves = [t for t in trace["traceEvents"]
+                if t["ph"] == "X" and "resolve" in t["name"]]
+    assert {t["args"]["sync_epoch"] for t in resolves} == {3}
+    # flow events tie launch -> resolve per epoch
+    assert any(t["ph"] == "s" and t["id"] == 3 for t in trace["traceEvents"])
+    assert any(t["ph"] == "f" and t["id"] == 3 for t in trace["traceEvents"])
+
+
+def test_lane_metadata_present_per_rank():
+    trace = chrome_trace([_ev(1.0, 2, "health.watchdog", "")])
+    names = {(t["pid"], t.get("args", {}).get("name"))
+             for t in trace["traceEvents"] if t["ph"] == "M"}
+    assert (2, "rank 2") in names
+    assert (2, "step") in names and (2, "sync-background") in names
+
+
+def test_export_writes_loadable_json(tmp_path):
+    journal.enable()
+    journal.record("sync.launch", label="m", sync_epoch=1)
+    journal.record("health.watchdog", label="process_allgather", timeout_s=5)
+    path = tmp_path / "trace.json"
+    trace = export_chrome_trace(str(path))
+    loaded = json.loads(path.read_text())
+    assert loaded["traceEvents"] == json.loads(json.dumps(trace))["traceEvents"]
+    assert len(loaded["traceEvents"]) >= 2
+    for t in loaded["traceEvents"]:
+        assert "ph" in t and "pid" in t and "ts" in t or t["ph"] == "M"
+
+
+def test_real_compiled_loop_exports(tmp_path):
+    from metrics_tpu.core.metric import Metric
+
+    class _Sum(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + jnp.sum(x)
+
+        def compute(self):
+            return self.total
+
+    journal.enable()
+    m = _Sum(compiled_update=True)
+    for _ in range(3):
+        m.update(jnp.asarray(np.ones((4,), np.float32)))
+    trace = export_chrome_trace(str(tmp_path / "t.json"))
+    dispatches = [t for t in trace["traceEvents"]
+                  if t["ph"] == "X" and t["name"] == "dispatch _Sum"]
+    assert len(dispatches) == 3
+    assert all(t["ts"] >= 0 for t in trace["traceEvents"] if t["ph"] != "M")
