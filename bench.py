@@ -13,7 +13,9 @@ config 10 the preemption-safe checkpoint snapshot/restore latency +
 restore-after-kill equivalence, config 11 the compiled eager hot path —
 compiled vs eager step time, dispatch counts and bit-equality, config 12
 the async overlapped sync, config 13 the telemetry recorder's hot-path
-overhead + trace-export smoke).
+overhead + trace-export smoke, config 14 the fleet-resilience simulation —
+quorum readmission latency after a transient partition plus the
+dead-rank degradation curve).
 
 Timing methodology (see BENCH.md): hot paths are timed **on-chip** by
 scanning K steps inside ONE jitted program (``lax.scan``) and dividing — a
@@ -2052,6 +2054,199 @@ def bench_config13() -> None:
     )
 
 
+def bench_config14() -> None:
+    """Config 14: fleet resilience — quorum-degraded sync over the FleetWorld
+    fault simulator: readmission latency after a transient partition (swept
+    over world size) and the capacity-retention curve as ranks die.
+
+    The ISSUE-16 acceptance measurement: ``on_missing="quorum"`` must turn
+    rank loss from a fleet-wide abort into a bounded, self-healing
+    degradation. Two deterministic scenarios run over FleetWorld (threads
+    harness with declarative FaultProfile fault injection, round-indexed so
+    every run is bit-reproducible):
+
+    **Recovery sweep** (W in 8/32): one rank is partitioned for two sync
+    rounds (``drop_rounds``). Survivors must shrink to a quorum within the
+    faulted round and the partitioned rank must be readmitted within ONE
+    round of the partition healing — with zero manual
+    ``reset_channel_health()`` calls (the probation state machine does the
+    readmission). Asserts (CI gates contract):
+
+    - pre-fault rounds never degrade (full membership, epoch 0);
+    - readmission completes in exactly one post-heal round at every swept
+      world size, ending at full membership;
+    - the ``channel_resets`` gauge is unchanged (no manual resets) while
+      ``quorum_shrinks``/``quorum_readmits`` advanced;
+    - survivors' synced values are bit-equal to each other every round.
+
+    **Degradation curve** (W=16, k in 0/2/4 ranks preempted at step 1):
+    survivors converge in one membership epoch and keep syncing; the curve
+    records the aggregate capacity retained (survivor sum / full-fleet sum
+    at the final round) per dead-rank count. Asserts the k=0 run never
+    degrades a round and matches the analytic full-fleet sum, and that for
+    every k the survivors agree bit-for-bit on the final value.
+
+    Emits `fleet_readmit_rounds` (rounds from partition heal to full
+    readmission, max over the W sweep) with `vs_baseline` = the degraded
+    fraction of gather rounds in the W=32 recovery run.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.observability.registry import process_snapshot
+    from metrics_tpu.parallel import resilience
+    from metrics_tpu.parallel.bucketing import clear_sync_plan_cache
+    from metrics_tpu.parallel.sync import host_sync_state
+    from tests.helpers.fake_world import FaultProfile, FleetWorld
+
+    class _Patch:
+        """Minimal monkeypatch.setattr stand-in for FleetWorld.install."""
+
+        def __init__(self):
+            self._saved = []
+
+        def setattr(self, obj, name, value):
+            self._saved.append((obj, name, getattr(obj, name)))
+            setattr(obj, name, value)
+
+        def undo(self):
+            while self._saved:
+                obj, name, val = self._saved.pop()
+                setattr(obj, name, val)
+
+    def run_fleet(world_size, profile, steps):
+        """Drive `steps` quorum sync rounds; returns per-rank tracks of
+        (synced_sum, membership_epoch, live_ranks), the world (for its
+        gather counters), and the wall-clock of the whole drive."""
+        world = FleetWorld(world_size, profile)
+        patch = _Patch()
+        clear_sync_plan_cache()
+        world.install(patch)
+        try:
+
+            def body(rank):
+                track = []
+                for step in range(steps):
+                    world.begin_round(rank, step)
+                    synced = host_sync_state(
+                        {"s": jnp.asarray(float(rank + step))},
+                        {"s": "sum"},
+                        update_count=1,
+                        timeout=0,
+                        on_missing="quorum",
+                        metric_name="bench14",
+                    )
+                    track.append(
+                        (
+                            float(np.asarray(synced["s"])),
+                            resilience.membership_epoch(),
+                            resilience.live_ranks(),
+                        )
+                    )
+                return track
+
+            t0 = time.perf_counter()
+            results = world.run(body, timeout=300.0)
+            wall = time.perf_counter() - t0
+        finally:
+            world.uninstall()
+            patch.undo()
+            clear_sync_plan_cache()
+        return results, world, wall
+
+    # ---- recovery sweep: transient 2-round partition, W in 8/32 ----
+    DROP_RANK, DROP_START, DROP_N, STEPS = 3, 2, 2, 7
+    heal_step = DROP_START + DROP_N
+    before = process_snapshot()
+    recovery = []
+    for W in (8, 32):
+        results, world, wall = run_fleet(
+            W,
+            FaultProfile(drop_rounds={DROP_RANK: (DROP_START, DROP_N)}),
+            STEPS,
+        )
+        full = tuple(range(W))
+        survivors = [r for r in range(W) if r != DROP_RANK]
+        for rank in survivors:
+            track = results[rank]
+            for step in range(DROP_START):  # pre-fault: never degraded
+                assert track[step][1:] == (0, full), (W, rank, step, track[step])
+            # survivors agree bit-for-bit with each other every round
+            assert track == results[survivors[0]], (W, rank)
+        # readmission: first full-membership round at/after the heal
+        sample = results[survivors[0]]
+        t_full = next(
+            t for t in range(heal_step, STEPS) if sample[t][2] == full
+        )
+        readmit_rounds = t_full - heal_step + 1
+        assert readmit_rounds == 1, (W, [v[1:] for v in sample])
+        assert sample[-1][0] == float(sum(r + (STEPS - 1) for r in range(W)))
+        assert world.gather_rounds_degraded > 0, W
+        recovery.append(
+            {
+                "world": W,
+                "readmit_rounds": readmit_rounds,
+                "degraded_gather_fraction": round(
+                    world.gather_rounds_degraded / world.gather_rounds_total, 4
+                ),
+                "wall_ms": round(wall * 1e3, 2),
+            }
+        )
+    after = process_snapshot()
+    assert after["channel_resets"] == before["channel_resets"], (
+        "readmission must not require manual reset_channel_health()"
+    )
+    assert after["quorum_shrinks"] > before["quorum_shrinks"]
+    assert after["quorum_readmits"] > before["quorum_readmits"]
+
+    # ---- degradation curve: k dead ranks at step 1, capacity retained ----
+    W, STEPS_K = 16, 6
+    curve = []
+    full_sum = None
+    for k in (0, 2, 4):
+        dead = {W - 1 - i: 1 for i in range(k)}
+        results, world, wall = run_fleet(
+            W, FaultProfile(preempt_at=dead), STEPS_K
+        )
+        assert world.preempted == set(dead), (k, world.preempted)
+        survivors = [r for r in range(W) if r not in dead]
+        final = results[survivors[0]][-1]
+        for rank in survivors:  # bit-equal survivor agreement
+            assert results[rank][-1] == final, (k, rank)
+        expect = float(sum(r + (STEPS_K - 1) for r in survivors))
+        assert final[0] == expect, (k, final, expect)
+        if k == 0:
+            full_sum = final[0]
+            assert world.gather_rounds_degraded == 0
+            assert final[1:] == (0, tuple(range(W)))
+        else:
+            assert final[1] == 1, (k, final)  # ONE membership epoch
+        curve.append(
+            {
+                "dead": k,
+                "survivors": len(survivors),
+                "epoch": final[1],
+                "capacity_retained": round(final[0] / full_sum, 4),
+                "wall_ms": round(wall * 1e3, 2),
+            }
+        )
+
+    readmit_max = max(r["readmit_rounds"] for r in recovery)
+    _diag(
+        config=14,
+        recovery_sweep=recovery,
+        drop={"rank": DROP_RANK, "rounds": [DROP_START, DROP_START + DROP_N - 1]},
+        degradation_curve=curve,
+        steps={"recovery": STEPS, "degradation": STEPS_K},
+    )
+    _emit(
+        "fleet_readmit_rounds",
+        readmit_max,
+        "rounds",
+        recovery[-1]["degraded_gather_fraction"],
+    )
+
+
 def main() -> None:
     try:
         platform = _ensure_backend()
@@ -2077,7 +2272,7 @@ def main() -> None:
     except Exception:
         vs = None
     _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
-    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10, "11": bench_config11, "12": bench_config12, "13": bench_config13}
+    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10, "11": bench_config11, "12": bench_config12, "13": bench_config13, "14": bench_config14}
     if "--config" in sys.argv:
         # comma-separated list (--config 9,11): related configs run in one
         # process and share compile-cache warmth (CI gates contract)

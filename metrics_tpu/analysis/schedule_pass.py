@@ -45,7 +45,12 @@ rank-tainted guard (``rank-dependent-collective``), by a local-data guard
 block (``collective-in-handler``), or emitted while iterating an unordered
 ``set`` (``nondeterministic-collective-order``). Early ``raise``/``return``
 under a local guard counts as governing every later collective in the
-function — skipping is as asymmetric as emitting.
+function — skipping is as asymmetric as emitting. The adaptive controller's
+``commit_schedule_decision`` (``parallel/resilience.py``) gets the same
+treatment one level up (``asymmetric-schedule-decision``): a sync-cadence /
+staleness-policy / timeout decision committed under — or computed from —
+rank/local taint changes which collectives ranks later emit, so it must
+derive from symmetric inputs only.
 """
 import ast
 from dataclasses import dataclass, field
@@ -106,7 +111,20 @@ LOCAL_DATA_PARAMS = frozenset(
 )
 
 #: calls whose results are per-rank local no matter the arguments
-_LOCAL_CALLS = frozenset({"channel_is_suspect", "process_index", "build_health_word"})
+#: (``channel_gate`` reads the per-process probation state machine —
+#: rank-local by construction, like the suspect latch it generalizes)
+_LOCAL_CALLS = frozenset(
+    {"channel_is_suspect", "channel_gate", "process_index", "build_health_word"}
+)
+
+#: the adaptive controller's one collective-affecting commit point
+#: (``parallel/resilience.py``): every sync-cadence / staleness-policy /
+#: timeout decision that can change WHICH collectives ranks emit flows
+#: through ``commit_schedule_decision``. The ``asymmetric-schedule-decision``
+#: rule checks its inputs are symmetric — a decision derived from rank- or
+#: data-tainted values would legally desynchronize the fleet one config knob
+#: at a time.
+SCHEDULE_DECISION_CALLS = frozenset({"commit_schedule_decision"})
 
 #: calls whose results are symmetric no matter the arguments (collective
 #: results are world-replicated; verify_health_words raises symmetrically
@@ -130,6 +148,28 @@ _SYMMETRIC_CALLS = COLLECTIVE_CALLS | KNOWN_EMITTING_CALLS | frozenset(
         "build_sync_plan",
         "_classify",
         "state_schema_parts",
+        # quorum membership (``parallel/resilience.py``) is agreed by a
+        # symmetric negotiation (every survivor runs the same
+        # max-of-proposals round) and re-verified by the header's
+        # membership-epoch/live-count columns before any payload moves —
+        # its readers are world-replicated over the survivor set, and the
+        # negotiation entry points re-establish symmetry by contract
+        "effective_world",
+        "membership_epoch",
+        "live_count",
+        "live_ranks",
+        "current_membership",
+        "negotiate_quorum",
+        "maybe_rejoin",
+        "negotiate_allgather",
+        "subset_allgather",
+        "active_subset_transport",
+        # the adaptive timeout is committed through
+        # commit_schedule_decision, whose inputs this pass verifies
+        # symmetric — so reading it back is symmetric
+        "adaptive_sync_timeout",
+        # pure classification of an already-symmetric typed failure
+        "is_missing_rank_error",
     }
 )
 
@@ -393,6 +433,11 @@ def check_function(
                         report(node, ctx, stmt)
                     elif isinstance(node, ast.Call) and records(node):
                         report_recorder(node, ctx)
+                    elif (
+                        isinstance(node, ast.Call)
+                        and _call_name(node.func) in SCHEDULE_DECISION_CALLS
+                    ):
+                        report_schedule_decision(node, ctx)
                     elif isinstance(node, ast.IfExp) and taint.classify(node.test) is not None:
                         t = taint.classify(node.test)
                         inner = _Ctx(ctx.guards + ((t, node.lineno),), ctx.handler, ctx.set_loop)
@@ -401,6 +446,11 @@ def check_function(
                                 report(sub, inner, stmt)
                             elif isinstance(sub, ast.Call) and records(sub):
                                 report_recorder(sub, inner)
+                            elif (
+                                isinstance(sub, ast.Call)
+                                and _call_name(sub.func) in SCHEDULE_DECISION_CALLS
+                            ):
+                                report_schedule_decision(sub, inner)
 
     def report(node: ast.Call, ctx: _Ctx, stmt: ast.stmt) -> None:
         name = _call_name(node.func) or "<collective>"
@@ -442,6 +492,38 @@ def check_function(
                     owner=info.name,
                 )
             )
+
+    def report_schedule_decision(node: ast.Call, ctx: _Ctx) -> None:
+        """A controller schedule decision (sync cadence, staleness policy,
+        adaptive timeout) committed under — or computed from — rank/local
+        taint: the committed value changes which collectives ranks later
+        emit, so an asymmetric decision desynchronizes the fleet exactly
+        like an asymmetric gather, one config knob removed."""
+        name = _call_name(node.func) or "commit_schedule_decision"
+        for t, line in list(ctx.guards) + early_exits:
+            findings.append(
+                Finding(
+                    "asymmetric-schedule-decision", path, node.lineno, node.col_offset,
+                    f"{info.name}: schedule decision {name}() is governed by a "
+                    f"{'rank' if t == 'rank' else 'per-rank data'}-dependent branch "
+                    f"(line {line}) — ranks taking different sides commit different "
+                    "collective-affecting decisions and their schedules diverge",
+                    owner=info.name,
+                )
+            )
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            t = taint.classify(arg)
+            if t is not None:
+                findings.append(
+                    Finding(
+                        "asymmetric-schedule-decision", path, node.lineno, node.col_offset,
+                        f"{info.name}: schedule decision {name}() derives from a "
+                        f"{'rank' if t == 'rank' else 'per-rank data'}-tainted value — "
+                        "collective-affecting decisions must be computed from "
+                        "symmetric inputs only (collective results, config, schema)",
+                        owner=info.name,
+                    )
+                )
 
     def report_recorder(node: ast.Call, ctx: _Ctx) -> None:
         """Telemetry emission under a rank/data-dependent guard: the journal
@@ -486,6 +568,9 @@ def run_schedule_pass(tree: ast.Module, path: str) -> List[Finding]:
             # via local record()-wrapping helpers): their emission sites
             # must be guard-free of per-rank branches
             or info.records
+            # functions that COMMIT SCHEDULE DECISIONS are checked for the
+            # asymmetric-schedule-decision rule even when they emit nothing
+            or any(c in SCHEDULE_DECISION_CALLS for c in info.calls)
         ):
             continue
         findings.extend(check_function(fns, info, path))

@@ -40,6 +40,7 @@ from metrics_tpu.core.compiled import (
 from metrics_tpu.core.metric import (
     _ComputeGroup,
     _ON_ERROR_MODES,
+    _ON_MISSING_MODES,
     _SYNC_MODES,
     Metric,
     _copy_state_value,
@@ -1230,6 +1231,7 @@ class MetricCollection(dict):
         should_sync: bool = True,
         distributed_available: Optional[Callable] = None,
         on_error: Optional[str] = None,
+        on_missing: Optional[str] = None,
         timeout: Optional[float] = None,
         blocking: Optional[bool] = None,
     ) -> None:
@@ -1282,10 +1284,23 @@ class MetricCollection(dict):
         full local accumulation is restored, otherwise the per-member
         *blocking* loop reruns so each member degrades (or recovers)
         independently.
+
+        ``on_missing`` (default: the members' ``sync_on_missing``) selects
+        the missing-rank policy, exactly as on :meth:`Metric.sync`: under
+        ``"quorum"`` the fused transport itself re-negotiates a shrunken
+        membership and retries over the survivor set
+        (``parallel/resilience.py``) before any failure surfaces here;
+        under ``"local"`` a missing-rank failure falls back to the
+        per-member loop (each member degrades to local-only) even when
+        every member's ``on_error`` is ``"raise"``.
         """
         if on_error is not None and on_error not in _ON_ERROR_MODES:
             raise MetricsTPUUserError(
                 f"`on_error` must be one of {_ON_ERROR_MODES}, got {on_error!r}"
+            )
+        if on_missing is not None and on_missing not in _ON_MISSING_MODES:
+            raise MetricsTPUUserError(
+                f"`on_missing` must be one of {_ON_MISSING_MODES}, got {on_missing!r}"
             )
         self._ensure_groups()
         overlap_auto = getattr(self, "sync_mode", "blocking") == "overlap"
@@ -1295,7 +1310,10 @@ class MetricCollection(dict):
         if should_sync and self.__dict__.get("_inflight_round") is not None:
             try:
                 self._resolve_overlap(
-                    on_error=on_error, timeout=timeout, relaunch=not blocking
+                    on_error=on_error,
+                    timeout=timeout,
+                    relaunch=not blocking,
+                    on_missing=on_missing,
                 )
                 return
             except SyncError as err:
@@ -1303,15 +1321,16 @@ class MetricCollection(dict):
                     on_error if on_error is not None else getattr(m, "sync_on_error", "raise")
                     for m in self.values()
                 ]
-                registry_of(self).count_error(
-                    err, degraded=not all(mode == "raise" for mode in modes)
-                )
+                degrades = not all(
+                    mode == "raise" for mode in modes
+                ) or self._missing_degrades(err, on_missing)
+                registry_of(self).count_error(err, degraded=degrades)
                 if journal.ACTIVE:
                     journal.record(
                         "health.failure", label="MetricCollection",
                         error=type(err).__name__, phase="resolve",
                     )
-                if all(mode == "raise" for mode in modes):
+                if not degrades:
                     raise  # every member's local accumulation was restored first
                 # degradation requested somewhere: every member holds its
                 # restored local state — rerun the per-member BLOCKING loop
@@ -1321,7 +1340,9 @@ class MetricCollection(dict):
                 blocking = True
         if should_sync and not blocking and dist_sync_fn is None:
             if self._overlap_eligible(distributed_available):
-                self._launch_overlap(timeout=timeout, serve_local=overlap_auto)
+                self._launch_overlap(
+                    timeout=timeout, serve_local=overlap_auto, on_missing=on_missing
+                )
                 return
             if not self.__dict__.get("_overlap_warned", False):
                 self._overlap_warned = True
@@ -1335,22 +1356,23 @@ class MetricCollection(dict):
             blocking = True
         if should_sync and dist_sync_fn is None and self._fused_sync_eligible(distributed_available):
             try:
-                self._sync_fused(timeout=timeout)
+                self._sync_fused(timeout=timeout, on_missing=on_missing)
                 return
             except SyncError as err:
                 modes = [
                     on_error if on_error is not None else getattr(m, "sync_on_error", "raise")
                     for m in self.values()
                 ]
-                registry_of(self).count_error(
-                    err, degraded=not all(mode == "raise" for mode in modes)
-                )
+                degrades = not all(
+                    mode == "raise" for mode in modes
+                ) or self._missing_degrades(err, on_missing)
+                registry_of(self).count_error(err, degraded=degrades)
                 if journal.ACTIVE:
                     journal.record(
                         "health.failure", label="MetricCollection",
                         error=type(err).__name__, phase="fused",
                     )
-                if all(mode == "raise" for mode in modes):
+                if not degrades:
                     raise  # nothing was synced: all-or-nothing holds trivially
                 # degradation requested somewhere: re-run per member so each
                 # applies its own on_error (healthy members still get global
@@ -1369,6 +1391,7 @@ class MetricCollection(dict):
                     should_sync=should_sync,
                     distributed_available=distributed_available,
                     on_error=on_error,
+                    on_missing=on_missing,
                     timeout=timeout,
                     blocking=blocking,
                 )
@@ -1383,6 +1406,33 @@ class MetricCollection(dict):
             # local-only state — a blocking rerun that fully recovered every
             # member is a recovery, not a degradation
             self._sync_stats_dict()["degraded"] += 1
+
+    def _missing_degrades(self, err: SyncError, on_missing: Optional[str]) -> bool:
+        """Does the ``on_missing="local"`` policy intercept this failure?
+        True when ``err`` is the missing-rank class (watchdog timeout /
+        membership-divergent header) and the explicit override — or some
+        member's ``sync_on_missing`` — asks for local-only degradation on
+        lost peers. The collection then reruns the per-member loop instead
+        of hard-raising, so each member applies its own policy."""
+        from metrics_tpu.parallel.resilience import is_missing_rank_error
+
+        if not is_missing_rank_error(err):
+            return False
+        return any(
+            (on_missing if on_missing is not None else getattr(m, "sync_on_missing", "raise"))
+            == "local"
+            for m in self.values()
+        )
+
+    def _effective_on_missing(self, on_missing: Optional[str]) -> str:
+        """The missing-rank policy a COMBINED (fused/overlapped) round runs
+        under: the explicit override, else the members' unanimous
+        ``sync_on_missing``, else ``"raise"`` (a split vote cannot be
+        honored by one shared transport — the per-member loop can)."""
+        if on_missing is not None:
+            return on_missing
+        modes = {getattr(m, "sync_on_missing", "raise") for m in self.values()}
+        return modes.pop() if len(modes) == 1 else "raise"
 
     def _fused_sync_eligible(self, distributed_available: Optional[Callable]) -> bool:
         """Can this collection sync through one combined bucketed plan?
@@ -1468,7 +1518,9 @@ class MetricCollection(dict):
                 owners.append((key, m, [p for p in g.members if p is not m]))
         return owners
 
-    def _sync_fused(self, timeout: Optional[float] = None) -> None:
+    def _sync_fused(
+        self, timeout: Optional[float] = None, on_missing: Optional[str] = None
+    ) -> None:
         """One bucketed plan over every *unique* member state (compute-group
         siblings dedupe to one payload; the header's count/length columns
         shrink accordingly).
@@ -1491,6 +1543,7 @@ class MetricCollection(dict):
             timeout=self._effective_member_timeout(timeout),
             metric_name=f"MetricCollection[{', '.join(self.keys())}]",
             fused=True,
+            on_missing=self._effective_on_missing(on_missing),
         )
         # snapshot each owner's pre-sync state only now: the sync never
         # mutates its inputs, and a failed attempt (the common case the
@@ -1542,6 +1595,7 @@ class MetricCollection(dict):
         owners: List[Tuple[str, Metric, List[Metric]]],
         state_of: Callable[[Metric], Dict[str, Any]],
         timeout: Optional[float],
+        on_missing: Optional[str] = None,
     ) -> None:
         """The one launch path for a collection round: build the combined
         key-prefixed payload from ``state_of(owner)`` (live state on a fresh
@@ -1558,6 +1612,7 @@ class MetricCollection(dict):
             metric_name=f"MetricCollection[{', '.join(self.keys())}]",
             timeout=self._effective_member_timeout(timeout),
             fused=True,
+            on_missing=self._effective_on_missing(on_missing),
         )
         self._inflight_round = round_
         self._inflight_owners = owners
@@ -1566,7 +1621,12 @@ class MetricCollection(dict):
             object.__setattr__(m, "_inflight_collection", self)
         self._sync_stats_dict()["launched"] += 1
 
-    def _launch_overlap(self, timeout: Optional[float] = None, serve_local: bool = False) -> None:
+    def _launch_overlap(
+        self,
+        timeout: Optional[float] = None,
+        serve_local: bool = False,
+        on_missing: Optional[str] = None,
+    ) -> None:
         """Launch ONE background round over the combined (group-deduped,
         key-prefixed) member states and restart every member on fresh delta
         buffers — the collection-level double buffer. ``serve_local`` (the
@@ -1574,7 +1634,7 @@ class MetricCollection(dict):
         member its just-snapshotted accumulation as this read's value."""
         owners = self._sync_state_owners()
         snapshots = {key: dict(m._state) for key, m, _peers in owners}  # move
-        self._launch_combined(owners, lambda m: m._state, timeout)
+        self._launch_combined(owners, lambda m: m._state, timeout, on_missing=on_missing)
         # the round owns the snapshot containers; members restart on fresh
         # defaults (group siblings re-link onto ONE fresh state)
         for _key, m, _peers in owners:
@@ -1647,6 +1707,7 @@ class MetricCollection(dict):
         on_error: Optional[str] = None,
         timeout: Optional[float] = None,
         relaunch: bool = False,
+        on_missing: Optional[str] = None,
     ) -> None:
         """Consume the collection's in-flight round and apply it to every
         member **all-or-nothing**: every member's policy view and restored
@@ -1722,14 +1783,18 @@ class MetricCollection(dict):
             # pipeline: hand every member's restored accumulation (their
             # unsync caches) to the next round, leaving fresh delta buffers
             # for the paired unsync
-            self._relaunch_from_caches(timeout)
+            self._relaunch_from_caches(timeout, on_missing=on_missing)
 
-    def _relaunch_from_caches(self, timeout: Optional[float]) -> None:
+    def _relaunch_from_caches(
+        self, timeout: Optional[float], on_missing: Optional[str] = None
+    ) -> None:
         """Pipeline relaunch: hand every member's restored accumulation (its
         unsync cache) to the next round, leaving fresh delta buffers for the
         paired unsync to restore."""
         owners = self._sync_state_owners()
-        self._launch_combined(owners, lambda m: m._cache or m._state, timeout)
+        self._launch_combined(
+            owners, lambda m: m._cache or m._state, timeout, on_missing=on_missing
+        )
         for _key, m, peers in owners:
             fresh = m._default_state()
             m._cache = fresh
@@ -1737,7 +1802,11 @@ class MetricCollection(dict):
                 p._cache = {k: _copy_state_value(v) for k, v in fresh.items()}
 
     def _resolve_member_request(
-        self, member: Metric, on_error: Optional[str] = None, timeout: Optional[float] = None
+        self,
+        member: Metric,
+        on_error: Optional[str] = None,
+        on_missing: Optional[str] = None,
+        timeout: Optional[float] = None,
     ) -> None:
         """A single member's read (``compute()``/``sync()``/``state_dict()``)
         while a COLLECTION round covers its state: the whole round resolves
@@ -1745,7 +1814,7 @@ class MetricCollection(dict):
         left synced — restore them together with the collection's
         :meth:`unsync`. The requesting member's own sync context then
         unsyncs just that member, exactly as its blocking compute would."""
-        self.sync(on_error=on_error, timeout=timeout, blocking=True)
+        self.sync(on_error=on_error, on_missing=on_missing, timeout=timeout, blocking=True)
 
     def _cancel_overlap(self) -> None:
         """The symmetric cancel for a collection round (``unsync()`` /
@@ -1804,6 +1873,7 @@ class MetricCollection(dict):
         should_unsync: bool = True,
         distributed_available: Optional[Callable] = None,
         on_error: Optional[str] = None,
+        on_missing: Optional[str] = None,
         timeout: Optional[float] = None,
         blocking: Optional[bool] = None,
     ) -> Iterator["MetricCollection"]:
@@ -1814,6 +1884,7 @@ class MetricCollection(dict):
             should_sync=should_sync,
             distributed_available=distributed_available,
             on_error=on_error,
+            on_missing=on_missing,
             timeout=timeout,
             blocking=blocking,
         )

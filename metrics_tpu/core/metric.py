@@ -82,6 +82,14 @@ from metrics_tpu.utils.prints import rank_zero_warn
 #: Accepted ``on_error`` / ``sync_on_error`` degradation modes.
 _ON_ERROR_MODES = ("raise", "local", "warn")
 
+#: Accepted ``on_missing`` / ``sync_on_missing`` missing-rank policies:
+#: ``"raise"`` treats a lost rank like any other SyncError (the ``on_error``
+#: ladder decides); ``"quorum"`` re-negotiates a shrunken membership and
+#: re-runs the gather over the survivor set (``parallel/resilience.py``);
+#: ``"local"`` degrades straight to local-only state on missing-rank
+#: failures specifically, even under ``on_error="raise"``.
+_ON_MISSING_MODES = ("raise", "quorum", "local")
+
 #: Accepted ``sync_mode`` values: ``"blocking"`` gathers inline at
 #: ``sync()``/``compute()``; ``"overlap"`` double-buffers — the gather rides
 #: a background thread while the training step keeps updating, and the next
@@ -558,6 +566,7 @@ class Metric:
         dist_sync_fn: Optional[Callable] = None,
         check_finite: bool = False,
         sync_on_error: str = "raise",
+        sync_on_missing: str = "raise",
         sync_timeout: Optional[float] = None,
         compiled_update: Optional[bool] = None,
         sync_mode: str = "blocking",
@@ -579,6 +588,11 @@ class Metric:
                 f"`sync_on_error` must be one of {_ON_ERROR_MODES}, got {sync_on_error!r}"
             )
         self.sync_on_error = sync_on_error
+        if sync_on_missing not in _ON_MISSING_MODES:
+            raise MetricsTPUUserError(
+                f"`sync_on_missing` must be one of {_ON_MISSING_MODES}, got {sync_on_missing!r}"
+            )
+        self.sync_on_missing = sync_on_missing
         self.sync_timeout = sync_timeout
         if sync_mode not in _SYNC_MODES:
             raise MetricsTPUUserError(
@@ -960,6 +974,7 @@ class Metric:
         state: Dict[str, Any],
         timeout: Optional[float] = None,
         fn: Optional[Callable] = None,
+        on_missing: Optional[str] = None,
     ) -> Dict[str, Any]:
         """Run the sync transport: injected ``fn`` (or ``self.dist_sync_fn``)
         if set, else the built-in health-checked host sync. The single place
@@ -982,6 +997,9 @@ class Metric:
             timeout=timeout if timeout is not None else getattr(self, "sync_timeout", None),
             metric_name=type(self).__name__,
             fused=getattr(self, "sync_fused", None),
+            on_missing=(
+                getattr(self, "sync_on_missing", "raise") if on_missing is None else on_missing
+            ),
         )
 
     def sync(
@@ -990,6 +1008,7 @@ class Metric:
         should_sync: bool = True,
         distributed_available: Optional[Callable] = None,
         on_error: Optional[str] = None,
+        on_missing: Optional[str] = None,
         timeout: Optional[float] = None,
         blocking: Optional[bool] = None,
     ) -> None:
@@ -1007,8 +1026,22 @@ class Metric:
           ``compute()`` then reports local data only);
         - ``"warn"``: like ``"local"`` but warns on every rank.
 
-        ``on_error``/``timeout`` default to the constructor's
-        ``sync_on_error``/``sync_timeout``.
+        ``on_error``/``on_missing``/``timeout`` default to the constructor's
+        ``sync_on_error``/``sync_on_missing``/``sync_timeout``. ``on_missing``
+        selects what a *missing-rank* failure specifically (watchdog timeout,
+        dead transport, membership-divergent header) does before the
+        ``on_error`` ladder ever sees it:
+
+        - ``"raise"`` (default): no special casing — the ``on_error`` ladder
+          decides, exactly as for any other typed ``SyncError``;
+        - ``"quorum"``: negotiate a shrunken membership over the reachable
+          survivor set and re-run the gather over the survivors only
+          (``parallel/resilience.py``); every rank that participates serves
+          the same survivor-folded value, and lost ranks rejoin at the next
+          membership epoch once their channel passes a probe round;
+        - ``"local"``: degrade straight to local-only state on missing-rank
+          failures, even under ``on_error="raise"`` (non-missing failures
+          such as schema divergence still follow ``on_error``).
 
         ``blocking=False`` launches a **non-blocking, double-buffered**
         round instead (``parallel/async_sync.py``): the current
@@ -1031,6 +1064,13 @@ class Metric:
             raise MetricsTPUUserError(
                 f"`on_error` must be one of {_ON_ERROR_MODES}, got {on_error!r}"
             )
+        on_missing = (
+            getattr(self, "sync_on_missing", "raise") if on_missing is None else on_missing
+        )
+        if on_missing not in _ON_MISSING_MODES:
+            raise MetricsTPUUserError(
+                f"`on_missing` must be one of {_ON_MISSING_MODES}, got {on_missing!r}"
+            )
         overlap_default = getattr(self, "sync_mode", "blocking") == "overlap"
         if blocking is None:
             blocking = not overlap_default
@@ -1040,11 +1080,14 @@ class Metric:
         if should_sync:
             owner = self.__dict__.get("_inflight_collection")
             if owner is not None:
-                owner._resolve_member_request(self, on_error=on_error, timeout=timeout)
+                owner._resolve_member_request(
+                    self, on_error=on_error, on_missing=on_missing, timeout=timeout
+                )
                 return
             if self.__dict__.get("_inflight") is not None:
                 self._resolve_overlap(
                     on_error=on_error,
+                    on_missing=on_missing,
                     timeout=timeout,
                     relaunch=not blocking,
                     dist_sync_fn=dist_sync_fn,
@@ -1069,26 +1112,42 @@ class Metric:
             # from the automatic pipeline: the caller is about to read, so
             # serve the local accumulation for this first interval
             self._launch_overlap(
-                dist_sync_fn=dist_sync_fn, timeout=timeout, serve_local=overlap_default
+                dist_sync_fn=dist_sync_fn,
+                timeout=timeout,
+                serve_local=overlap_default,
+                on_missing=on_missing,
             )
             return
         self._cache = {k: _copy_state_value(v) for k, v in self._state.items()}
         self._sync_degraded = False
         try:
-            synced = self._run_dist_sync(self._cache, timeout=timeout, fn=fn)
+            synced = self._run_dist_sync(
+                self._cache, timeout=timeout, fn=fn, on_missing=on_missing
+            )
         except SyncError as err:
-            self._handle_sync_failure(err, on_error)
+            self._handle_sync_failure(err, on_error, on_missing=on_missing)
             return
         self._restore(synced)
         self._is_synced = True
 
-    def _handle_sync_failure(self, err: SyncError, on_error: str) -> None:
+    def _handle_sync_failure(
+        self, err: SyncError, on_error: str, on_missing: str = "raise"
+    ) -> None:
         """The shared ``on_error`` ladder for a failed sync — a blocking
         gather or a resolved overlapped round, degradation identical either
         way. The caller has already restored (or never touched) the full
         local accumulation; this clears the sync cache, re-raises under
         ``"raise"``, and otherwise marks the degradation (so a paired
-        ``unsync()`` is a tolerated no-op) and warns."""
+        ``unsync()`` is a tolerated no-op) and warns. ``on_missing="local"``
+        intercepts the *missing-rank* error class specifically — watchdog
+        timeouts and membership-divergent headers degrade to local-only
+        even when ``on_error`` would raise (a lost peer is an expected
+        fleet event, not a logic error on this rank)."""
+        if on_missing == "local" and on_error == "raise":
+            from metrics_tpu.parallel.resilience import is_missing_rank_error
+
+            if is_missing_rank_error(err):
+                on_error = "local"
         self._cache = None
         registry_of(self).count_error(err, degraded=on_error != "raise")
         if journal.ACTIVE:
@@ -1185,6 +1244,7 @@ class Metric:
         should_unsync: bool = True,
         distributed_available: Optional[Callable] = None,
         on_error: Optional[str] = None,
+        on_missing: Optional[str] = None,
         timeout: Optional[float] = None,
         blocking: Optional[bool] = None,
     ) -> "Metric._SyncContext":
@@ -1204,6 +1264,7 @@ class Metric:
             should_unsync=should_unsync,
             distributed_available=distributed_available,
             on_error=on_error,
+            on_missing=on_missing,
             timeout=timeout,
             blocking=blocking,
         )
@@ -1281,6 +1342,7 @@ class Metric:
         dist_sync_fn: Optional[Callable] = None,
         timeout: Optional[float] = None,
         serve_local: bool = False,
+        on_missing: Optional[str] = None,
     ) -> None:
         """Snapshot the accumulation, launch the background gather, return.
 
@@ -1303,7 +1365,7 @@ class Metric:
         self._group_detach_if_stray()
         snapshot = dict(self._state)  # move container ownership to the round
         self._restore(self._default_state())
-        self._launch_overlap_from(snapshot, dist_sync_fn, timeout)
+        self._launch_overlap_from(snapshot, dist_sync_fn, timeout, on_missing=on_missing)
         if serve_local:
             round_ = self.__dict__["_inflight"]
             self._cache = {k: _copy_state_value(v) for k, v in self._state.items()}
@@ -1319,6 +1381,7 @@ class Metric:
         snapshot: Dict[str, Any],
         dist_sync_fn: Optional[Callable],
         timeout: Optional[float],
+        on_missing: Optional[str] = None,
     ) -> None:
         """Launch one round over ``snapshot`` (ownership transferred)."""
         object.__setattr__(self, "_sync_epoch", getattr(self, "_sync_epoch", 0) + 1)
@@ -1337,6 +1400,9 @@ class Metric:
             timeout=timeout if timeout is not None else getattr(self, "sync_timeout", None),
             fused=getattr(self, "sync_fused", None),
             sync_fn=sync_fn,
+            on_missing=(
+                getattr(self, "sync_on_missing", "raise") if on_missing is None else on_missing
+            ),
         )
         object.__setattr__(self, "_inflight", round_)
         self._sync_stats_dict()["launched"] += 1
@@ -1356,6 +1422,7 @@ class Metric:
     def _resolve_overlap(
         self,
         on_error: Optional[str] = None,
+        on_missing: Optional[str] = None,
         timeout: Optional[float] = None,
         relaunch: bool = False,
         dist_sync_fn: Optional[Callable] = None,
@@ -1372,6 +1439,9 @@ class Metric:
         restored local accumulation straight to the next round.
         """
         on_error = getattr(self, "sync_on_error", "raise") if on_error is None else on_error
+        on_missing = (
+            getattr(self, "sync_on_missing", "raise") if on_missing is None else on_missing
+        )
         round_ = self.__dict__["_inflight"]
         object.__setattr__(self, "_inflight", None)
         stats = self._sync_stats_dict()
@@ -1383,7 +1453,8 @@ class Metric:
             )
         except SyncError as err:
             self._fold_back_round(round_, stale)
-            self._handle_sync_failure(err, on_error)  # raises under "raise"
+            # raises under "raise" (unless on_missing intercepts)
+            self._handle_sync_failure(err, on_error, on_missing=on_missing)
             stats["degraded"] += 1
             return
         stats["resolved"] += 1
@@ -1434,7 +1505,9 @@ class Metric:
             # the paired unsync to restore
             next_snapshot = self._cache
             self._cache = self._default_state()
-            self._launch_overlap_from(next_snapshot, dist_sync_fn, timeout)
+            self._launch_overlap_from(
+                next_snapshot, dist_sync_fn, timeout, on_missing=on_missing
+            )
 
     def _cancel_overlap(self) -> None:
         """The symmetric cancel (``unsync()``/``reset()``/copy paths while a

@@ -77,8 +77,18 @@ EVENT_KINDS: Dict[str, str] = {
     # ---- health / fault tolerance (parallel/health.py) -------------------
     "health.failure": "a typed SyncError observed at a sync boundary",
     "health.watchdog": "a sync watchdog fired on a stuck collective",
-    "health.channel_suspect": "the process-wide channel-suspect latch set",
-    "health.channel_reset": "the channel-suspect latch cleared",
+    "health.margin": "a guarded collective finished, with watchdog headroom",
+    "health.channel_suspect": "the channel entered probation (suspect)",
+    "health.channel_probe": "probation cooldown elapsed; one probe round allowed",
+    "health.channel_readmit": "a probe round succeeded; channel readmitted",
+    "health.channel_reset": "the channel forced healthy (manual reset)",
+    # ---- elastic resilience (parallel/resilience.py) ---------------------
+    "resilience.membership": "a negotiated membership transition (shrink/readmit)",
+    "resilience.quorum": "a quorum-degraded sync negotiated over survivors",
+    # ---- adaptive controller (parallel/resilience.py) --------------------
+    "controller.timeout": "the controller committed a new watchdog timeout",
+    "controller.schedule": "a schedule-affecting controller decision committed",
+    "controller.revert": "controller decisions reverted to defaults",
     # ---- degradation (Metric._handle_sync_failure) -----------------------
     "degrade.local": "a sync failure swallowed under on_error='local'/'warn'",
     # ---- checkpointing (core/checkpoint.py) ------------------------------

@@ -32,8 +32,11 @@ __all__ = [
     "TELEMETRY_SCHEMA",
     "PROCESS",
     "StatsRegistry",
+    "add_process",
+    "bump_process",
     "process_snapshot",
     "registry_of",
+    "set_process",
     "telemetry_jsonl",
     "telemetry_prometheus",
 ]
@@ -78,13 +81,25 @@ DOMAIN_DEFAULTS: Dict[str, Dict[str, Any]] = {
     },
 }
 
-#: Process-wide counters (no instance owns a watchdog): bumped by
-#: ``parallel/health.py``, snapshotted under the ``process`` key of every
-#: ``telemetry()`` call.
-PROCESS: Dict[str, int] = {
+#: Process-wide counters and gauges (no instance owns a watchdog): bumped
+#: by ``parallel/health.py`` / ``parallel/resilience.py``, snapshotted under
+#: the ``process`` key of every ``telemetry()`` call. The ``*_s`` entries
+#: are seconds: ``suspect_episode_s`` accumulates how long the channel spent
+#: in probation across episodes, ``watchdog_margin_s`` is the LAST observed
+#: headroom (timeout minus gather time — the adaptive controller's signal),
+#: ``adaptive_timeout_s`` the controller's current watchdog bound (0 = not
+#: tuning).
+PROCESS: Dict[str, Any] = {
     "watchdog_fired": 0,
     "channel_suspect_latched": 0,
     "channel_resets": 0,
+    "channel_readmits": 0,
+    "membership_transitions": 0,
+    "quorum_shrinks": 0,
+    "quorum_readmits": 0,
+    "suspect_episode_s": 0.0,
+    "watchdog_margin_s": 0.0,
+    "adaptive_timeout_s": 0.0,
 }
 _PROCESS_LOCK = threading.Lock()
 
@@ -92,6 +107,18 @@ _PROCESS_LOCK = threading.Lock()
 def bump_process(key: str, by: int = 1) -> None:
     with _PROCESS_LOCK:
         PROCESS[key] = PROCESS.get(key, 0) + by
+
+
+def add_process(key: str, by: float) -> None:
+    """Accumulate a float process gauge (e.g. probation episode seconds)."""
+    with _PROCESS_LOCK:
+        PROCESS[key] = PROCESS.get(key, 0.0) + float(by)
+
+
+def set_process(key: str, value: float) -> None:
+    """Set a last-observed process gauge (e.g. the watchdog margin)."""
+    with _PROCESS_LOCK:
+        PROCESS[key] = value
 
 
 def process_snapshot() -> Dict[str, Any]:

@@ -187,7 +187,7 @@ _EXECUTOR: Optional[SerialExecutor] = None
 _EXECUTOR_LOCK = threading.Lock()
 
 _PENDING_LOCK = threading.Lock()
-_PENDING: Dict[Any, Any] = {}  # future -> launch domain
+_PENDING: Dict[Any, Any] = {}  # future -> (launch domain, sync_epoch, metric_name)
 
 #: Thread-local marker: set while the executor thread runs a round's task,
 #: so :func:`sync_channel` skips the drain (a round waiting on itself would
@@ -232,20 +232,27 @@ def _drain_pending(timeout: Optional[float] = None) -> None:
     would."""
     domain = _current_domain()
     with _PENDING_LOCK:
-        pending = [f for f, d in _PENDING.items() if d == domain]
+        pending = {f: meta for f, meta in _PENDING.items() if meta[0] == domain}
     if not pending:
         return
     from metrics_tpu.parallel.health import get_sync_timeout, mark_channel_suspect
 
     limit = get_sync_timeout(timeout)
-    _done, not_done = _futures_wait(pending, timeout=limit if limit > 0 else None)
+    start = time.monotonic()
+    _done, not_done = _futures_wait(list(pending), timeout=limit if limit > 0 else None)
     if not_done:
         mark_channel_suspect()
+        elapsed = time.monotonic() - start
+        stuck = sorted(
+            f"sync_epoch {pending[f][1]} of {pending[f][2]}" for f in not_done
+        )
         raise SyncTimeoutError(
-            f"{len(not_done)} in-flight overlapped sync round(s) did not "
-            f"complete within {limit:g}s — a peer process is likely dead or "
-            "stalled mid-round. Raise METRICS_TPU_SYNC_TIMEOUT_S for slow "
-            "interconnects, or recover with on_error='local'."
+            f"{len(not_done)} in-flight overlapped sync round(s) "
+            f"({'; '.join(stuck)}) did not complete within {limit:g}s "
+            f"(waited {elapsed:.1f}s; configured watchdog timeout {limit:g}s) "
+            "— a peer process is likely dead or stalled mid-round. Raise "
+            "METRICS_TPU_SYNC_TIMEOUT_S for slow interconnects, or recover "
+            "with on_error='local'."
         )
 
 
@@ -329,6 +336,7 @@ def launch_round(
     timeout: Optional[float] = None,
     fused: Optional[bool] = None,
     sync_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+    on_missing: str = "raise",
 ) -> AsyncSyncRound:
     """Launch the health-checked host sync of ``snapshot`` on the background
     lane and return immediately.
@@ -339,7 +347,9 @@ def launch_round(
     running on another thread. ``sync_fn`` overrides the transport (a custom
     ``dist_sync_fn``); the default is
     :func:`~metrics_tpu.parallel.sync.host_sync_state` with this round's
-    ``sync_epoch`` riding the header.
+    ``sync_epoch`` riding the header and ``on_missing`` threaded through —
+    a quorum-degraded background round shrinks and retries over the
+    survivor set exactly like a blocking one.
     """
     round_ = AsyncSyncRound(
         snapshot,
@@ -368,6 +378,7 @@ def launch_round(
                 metric_name=round_.metric_name,
                 fused=fused,
                 sync_epoch=round_.epoch,
+                on_missing=on_missing,
             )
         finally:
             round_.gather_s = time.monotonic() - start
@@ -382,7 +393,7 @@ def launch_round(
     future = _get_executor().submit(task)
     round_.future = future
     with _PENDING_LOCK:
-        _PENDING[future] = domain
+        _PENDING[future] = (domain, round_.epoch, metric_name)
     future.add_done_callback(_discard_pending)
     return round_
 
@@ -412,11 +423,13 @@ def resolve_round(round_: AsyncSyncRound, timeout: Optional[float] = None):
         synced = round_.future.result(timeout=2 * limit if limit > 0 else None)
     except _FutureTimeoutError:
         mark_channel_suspect()
+        elapsed = time.monotonic() - start
         raise SyncTimeoutError(
-            f"overlapped sync round {round_.epoch} of {round_.metric_name} did "
-            f"not resolve within {2 * limit:g}s — a peer process is likely dead "
-            "or stalled mid-round. Recover with on_error='local' or restart "
-            "the process group."
+            f"overlapped sync round of {round_.metric_name} did not resolve "
+            f"within {2 * limit:g}s (sync_epoch={round_.epoch}, waited "
+            f"{elapsed:.1f}s, configured watchdog timeout {limit:g}s) — a "
+            "peer process is likely dead or stalled mid-round. Recover with "
+            "on_error='local' or restart the process group."
         ) from None
     return synced, time.monotonic() - start
 

@@ -290,9 +290,10 @@ def host_sync_state_bucketed(
     per-leaf cost of callable-``fx`` fallbacks, and one length-vector gather
     only when the schema outgrows the header's ``CAT_LENGTH_SLOTS``).
     """
+    from metrics_tpu.parallel.resilience import effective_world
     from metrics_tpu.parallel.sync import _process_allgather, host_sync_leaf
 
-    world = jax.process_count()
+    world = effective_world()
     if plan is None:
         plan = build_sync_plan(state, reductions)
     out: Dict[str, Any] = {}

@@ -12,7 +12,7 @@ payload gather, every rank contributes one small int32 *health word* per
 metric in a *single* ``process_allgather``::
 
     [version, schema_hash, update_count, overflow, nonfinite, n_states,
-     sync_epoch,
+     sync_epoch, member_epoch, live_count,
      count_0 ... count_{COUNT_SLOTS-1},
      len_0 ... len_{CAT_LENGTH_SLOTS-1}]
 
@@ -35,6 +35,20 @@ metric in a *single* ``process_allgather``::
                     is still blocking (or already on round N+1) raises a
                     typed ``StateDivergenceError`` on every rank instead of
                     pairing a background gather with a foreground one;
+- ``member_epoch`` the negotiated quorum-membership epoch
+                    (``parallel/resilience.py``): ``0`` for the full fleet,
+                    incremented by every agreed shrink/readmit transition.
+                    Verified equal across the gathered words, so a rank
+                    that missed a membership transition raises a typed
+                    ``StateDivergenceError`` instead of pairing collectives
+                    across disagreeing survivor sets;
+- ``live_count``    how many ranks this rank believes participate in the
+                    current membership — the cheap checksum of the live SET
+                    (the set itself is agreed out of band by the quorum
+                    probe/negotiation protocol; a full bitmap would cost
+                    ``ceil(world/32)`` columns at fleet scale for no extra
+                    safety, since epoch+count already diverge whenever the
+                    sets do);
 - ``count_j``       participation count of the j-th state (sorted by name):
                     CatBuffer fill count, number of appended batches for
                     list states (a rank that appended one zero-row batch
@@ -88,7 +102,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.observability import journal
-from metrics_tpu.observability.registry import bump_process
+from metrics_tpu.observability.registry import bump_process, set_process
 from metrics_tpu.utils.exceptions import (
     NonFiniteStateError,
     StateDivergenceError,
@@ -127,9 +141,10 @@ T = TypeVar("T")
 #: v2: CAT_LENGTH_SLOTS per-leaf row-length columns appended to the word so
 #: the bucketed planner can size ragged payload buffers with zero extra
 #: shape gathers. v3: the ``sync_epoch`` column (overlapped-round alignment
-#: for ``parallel/async_sync.py``). v1/v2 peers are caught by the
-#: width/version checks.
-HEALTH_PROTOCOL_VERSION = 3
+#: for ``parallel/async_sync.py``). v4: the ``member_epoch`` and
+#: ``live_count`` columns (quorum membership, ``parallel/resilience.py``).
+#: Older peers are caught by the width/version checks.
+HEALTH_PROTOCOL_VERSION = 4
 
 #: Reserved state name for the ``check_finite`` poison flag (see
 #: ``Metric.enable_check_finite``): an int32 scalar with ``dist_reduce_fx="sum"``
@@ -153,7 +168,9 @@ _F_OVERFLOW = 3
 _F_NONFINITE = 4
 _F_NSTATES = 5
 _F_EPOCH = 6
-_F_FIXED = 7
+_F_MEMBER_EPOCH = 7
+_F_LIVE = 8
+_F_FIXED = 9
 
 #: Fixed number of per-state count slots; unused slots hold the -1 sentinel.
 COUNT_SLOTS = 16
@@ -169,9 +186,23 @@ DEFAULT_SYNC_TIMEOUT_S = 600.0
 
 
 def get_sync_timeout(override: Optional[float] = None) -> float:
-    """Effective watchdog timeout: explicit override > env knob > default."""
+    """Effective watchdog timeout: explicit override > adaptive controller
+    > env knob > default.
+
+    The adaptive tier is the :class:`~metrics_tpu.parallel.resilience.AdaptiveController`'s
+    EWMA-derived bound (``max(floor, multiplier * ewma(gather_s))``) —
+    replacing the static 600 s default as the only line of defense once a
+    controller is running. The watchdog is a rank-local *liveness guard*
+    (it bounds how long a rank waits, never which collectives are issued),
+    so a per-rank adaptive bound is safe-asymmetric by construction.
+    """
     if override is not None:
         return float(override)
+    from metrics_tpu.parallel.resilience import adaptive_sync_timeout
+
+    adaptive = adaptive_sync_timeout()
+    if adaptive is not None:
+        return float(adaptive)
     return float(os.environ.get("METRICS_TPU_SYNC_TIMEOUT_S", DEFAULT_SYNC_TIMEOUT_S))
 
 
@@ -415,6 +446,8 @@ def build_health_word(
     cat_names = [n for n in names if _is_cat_family(kinds[n], reductions.get(n))]
     for j, name in enumerate(cat_names[:CAT_LENGTH_SLOTS]):
         length_slots[j] = cat_row_count(state[name], kinds[name])
+    from metrics_tpu.parallel.resilience import live_count, membership_epoch
+
     word = [
         HEALTH_PROTOCOL_VERSION,
         state_schema_hash(state, reductions),
@@ -423,6 +456,8 @@ def build_health_word(
         nonfinite,
         len(names),
         int(sync_epoch),
+        int(membership_epoch()),
+        int(live_count()),
     ] + slots + length_slots
     return np.asarray(word, dtype=np.int32)
 
@@ -474,6 +509,24 @@ def verify_health_words(
             f"{epochs.tolist()} differ — ranks disagree whether (or which) "
             "overlapped sync round this collective belongs to. Launch "
             "non-blocking syncs at the same step on every rank. All "
+            "processes raised together."
+        )
+
+    # 0b) membership skew: ranks disagreeing which quorum membership this
+    #     collective runs over (a rank that missed a shrink/readmit
+    #     transition) would pair payload gathers across different survivor
+    #     sets — under on_missing="quorum" this is the trigger for a fresh
+    #     probe/negotiation round; otherwise it degrades like any divergence
+    member_epochs = words[:, _F_MEMBER_EPOCH]
+    live_counts = words[:, _F_LIVE]
+    if not (member_epochs == member_epochs[0]).all() or not (
+        live_counts == live_counts[0]
+    ).all():
+        raise StateDivergenceError(
+            f"membership skew for {metric_name}: per-rank membership epochs "
+            f"{member_epochs.tolist()} / live counts {live_counts.tolist()} "
+            "differ — ranks disagree which quorum membership this collective "
+            "runs over (a rank missed a shrink or readmit transition). All "
             "processes raised together."
         )
 
@@ -563,49 +616,47 @@ def verify_health_words(
 # Liveness guards: sync watchdog + coordinator-bind retry
 # ---------------------------------------------------------------------------
 
-# Latched when a watchdog fires mid-collective: the abandoned worker thread
-# may still be inside the gather, so the process's NEXT collective can pair
-# with a peer's stale one and "succeed" with wrong data. host_sync_state
-# refuses to issue new collectives while the latch is set (degrading cleanly
-# under on_error="local") instead of corrupting silently.
-_channel_suspect = threading.Event()
-#: serializes latch/clear transitions so concurrent markers (watchdog thread
-#: vs background resolve lane) count and journal each episode exactly once
-_suspect_transition_lock = threading.Lock()
+# The channel-suspect "latch" is now a probation state machine
+# (``parallel/resilience.py``): a fired watchdog still makes the process's
+# NEXT collective refuse (the abandoned worker thread may still be inside
+# the stale gather), but instead of staying poisoned until a manual
+# ``reset_channel_health()``, the channel cools down with exponential
+# backoff, lets one probe round through, and readmits itself when the probe
+# succeeds. These module-level functions delegate so every historical
+# import site (and the fault-injection suite) keeps working unchanged.
 
 
 def channel_is_suspect() -> bool:
-    """True once a sync watchdog has fired: collective ordering is no longer
-    trusted and new host syncs are refused until :func:`reset_channel_health`."""
-    return _channel_suspect.is_set()
+    """True while the channel is in probation (a sync watchdog fired and no
+    probe round has succeeded yet): collective ordering is not trusted, so
+    new host syncs are refused — until the probation machine readmits the
+    channel (``parallel/resilience.py``) or :func:`reset_channel_health`
+    forces it."""
+    from metrics_tpu.parallel import resilience
+
+    return resilience.channel_is_suspect()
 
 
 def mark_channel_suspect() -> None:
-    """Latch the suspect flag — the one emission site for the transition
-    (the watchdog, and the async overlap layer when an in-flight round's
-    future cannot complete, both land here), so the journal records the
-    latch exactly once per suspect episode. The transition lock makes the
-    check-and-set atomic: a watchdog thread and a background resolve lane
-    latching concurrently must not double-count the episode."""
-    with _suspect_transition_lock:
-        if _channel_suspect.is_set():
-            return
-        _channel_suspect.set()
-    bump_process("channel_suspect_latched")
-    if journal.ACTIVE:
-        journal.record("health.channel_suspect")
+    """Enter probation — the one emission site for the transition (the
+    watchdog, and the async overlap layer when an in-flight round's future
+    cannot complete, both land here), so the journal records the episode
+    entry exactly once. A failed probe round re-enters with doubled
+    cooldown (exponential backoff)."""
+    from metrics_tpu.parallel import resilience
+
+    resilience.mark_channel_suspect()
 
 
 def reset_channel_health() -> None:
-    """Clear the suspect latch — call only after the process group has been
-    re-established (or in tests that simulate the channel)."""
-    with _suspect_transition_lock:
-        if not _channel_suspect.is_set():
-            return
-        _channel_suspect.clear()
-    bump_process("channel_resets")
-    if journal.ACTIVE:
-        journal.record("health.channel_reset")
+    """Force the channel healthy immediately — the manual recovery hook for
+    operators that re-established the process group out of band (and for
+    tests that simulate the channel). With the probation machine this is
+    optional: a suspect channel heals itself via cooldown → probe →
+    readmit."""
+    from metrics_tpu.parallel import resilience
+
+    resilience.reset_channel_health()
 
 
 def call_with_sync_watchdog(
@@ -639,6 +690,7 @@ def call_with_sync_watchdog(
             box["error"] = err
 
     worker = threading.Thread(target=_run, name=f"metrics-tpu-watchdog[{what}]", daemon=True)
+    started = time.monotonic()
     worker.start()
     worker.join(timeout)
     if worker.is_alive():
@@ -650,6 +702,16 @@ def call_with_sync_watchdog(
             f"{what} did not complete within {timeout:g}s — a peer process is "
             "likely dead or stalled. Raise METRICS_TPU_SYNC_TIMEOUT_S for slow "
             "interconnects, or recover with Metric.sync(on_error='local')."
+        )
+    # watchdog margin: the headroom between the bound and the observed
+    # collective time — the adaptive controller's (and fleet dashboards')
+    # signal that the bound is getting tight, not just a fired/not-fired bit
+    elapsed = time.monotonic() - started
+    set_process("watchdog_margin_s", timeout - elapsed)
+    if journal.ACTIVE:
+        journal.record(
+            "health.margin", label=what, elapsed_s=elapsed,
+            timeout_s=timeout, margin_s=timeout - elapsed,
         )
     if "error" in box:
         raise box["error"]

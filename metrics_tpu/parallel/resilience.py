@@ -1,0 +1,797 @@
+"""Elastic fleet resilience: quorum membership, probation healing, adaptive control.
+
+A single dead or preempted rank historically collapsed the whole fleet to
+local-only metrics: the sync watchdog fired, the channel-suspect latch
+poisoned every later sync, and recovery required a manual
+``reset_channel_health()`` call no production loop ever makes. This module
+replaces that blanket degradation with three cooperating mechanisms:
+
+- **Quorum membership.** A per-process :class:`Membership` (epoch + sorted
+  live-rank tuple) describes which ranks currently participate in host
+  collectives. Under ``on_missing="quorum"`` (``Metric.sync`` /
+  ``MetricCollection.sync``), a sync round that loses ranks negotiates a
+  shrunken membership *symmetrically* — every survivor probes the same
+  world state, proposes ``local_epoch + 1``, and agrees on the max over the
+  survivor set — then the caller re-runs the health-checked gather over the
+  survivor set only. The health word carries the membership epoch and live
+  count (protocol v4, ``parallel/health.py``), so a rank that missed a
+  transition raises a typed ``StateDivergenceError`` on every rank instead
+  of pairing collectives across disagreeing memberships. When every rank is
+  live, none of this code runs: the non-degraded fast path is the
+  pre-quorum sync, bit for bit.
+- **Probation (self-healing channel).** The permanent channel-suspect latch
+  becomes a state machine: ``healthy → suspect`` (a watchdog fired) →
+  cooldown with exponential backoff → ``probe`` (one sync round is allowed
+  through) → readmitted on success, re-suspected with doubled backoff on
+  failure. ``parallel/health.py``'s public latch API delegates here, so
+  existing callers (and the fault-injection suite) keep their semantics:
+  a freshly suspected channel still refuses syncs, but it now heals itself
+  once the cooldown elapses and a probe round succeeds — zero manual
+  ``reset_channel_health()`` calls.
+- **Adaptive control.** :class:`AdaptiveController` subscribes to the
+  telemetry journal (``observability.on_event``) and tunes the watchdog
+  timeout from an EWMA of observed gather times (with a floor), replacing
+  the static 600 s default as the only line of defense. The watchdog bound
+  is a *rank-local liveness guard* — it never changes which collectives are
+  issued, only how long a rank waits before declaring a peer dead — so
+  tuning it from rank-local timings is safe by construction. Decisions that
+  WOULD change the collective schedule (sync cadence, staleness policy) must
+  flow through :func:`commit_schedule_decision`, whose inputs
+  ``metricslint``'s schedule pass verifies are symmetric (membership epoch,
+  health-word columns); every decision is journaled and revertible.
+
+Every membership transition and controller decision is a typed, journaled
+event (``resilience.membership``, ``resilience.quorum``,
+``controller.timeout``, ``controller.schedule``, ``controller.revert``).
+
+**Transport.** Shrinking a JAX process group in place is not expressible
+with ``multihost_utils.process_allgather`` (the collective is defined over
+the full world), so subset gathers and membership negotiation ride a
+pluggable :func:`set_quorum_transport` seam. Simulated fleets
+(``tests/helpers/fake_world.py``) install one; production deployments can
+back it with a side channel (e.g. the coordinator KV store). Without a
+transport, quorum mode degrades gracefully: a ``warn_once`` diagnostic
+fires and the error falls through to the ``on_error`` ladder unchanged.
+"""
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.observability import journal
+from metrics_tpu.observability.registry import add_process, bump_process, set_process
+from metrics_tpu.utils.exceptions import (
+    StateDivergenceError,
+    SyncError,
+    SyncTimeoutError,
+)
+
+__all__ = [
+    "Membership",
+    "AdaptiveController",
+    "advance_membership",
+    "active_subset_transport",
+    "adaptive_sync_timeout",
+    "channel_gate",
+    "channel_is_suspect",
+    "channel_probe_succeeded",
+    "commit_schedule_decision",
+    "configure_probation",
+    "current_membership",
+    "effective_world",
+    "is_missing_rank_error",
+    "last_schedule_decisions",
+    "live_ranks",
+    "mark_channel_suspect",
+    "maybe_rejoin",
+    "membership_epoch",
+    "negotiate_quorum",
+    "note_sync_round",
+    "reset_channel_health",
+    "reset_resilience",
+    "set_quorum_transport",
+]
+
+#: patchable clock seam (probation tests freeze it instead of sleeping)
+_now = time.monotonic
+
+
+def _current_domain() -> Any:
+    """Identity of the owning "process". In production every rank IS its own
+    process, so one constant domain suffices and all per-domain state below
+    is effectively process-global. Simulated multi-rank worlds (thread-per-
+    rank harnesses, ``tests/helpers/fake_world.py``) share this module
+    across fake ranks and monkeypatch this to the current thread's rank
+    identity — mirroring ``async_sync._current_domain`` — so each fake rank
+    gets its own membership, probation state, and flap window."""
+    return None
+
+
+_STATE_LOCK = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# Membership: who participates in host collectives right now
+# ---------------------------------------------------------------------------
+
+
+class Membership:
+    """One negotiated membership: ``epoch`` (monotonic per domain), the
+    sorted ``live`` rank tuple, and the full ``world`` size the fleet
+    started with. ``degraded`` is the one bit the sync path branches on —
+    a non-degraded membership takes the exact pre-quorum code path."""
+
+    __slots__ = ("epoch", "live", "world")
+
+    def __init__(self, epoch: int, live: Any, world: int) -> None:
+        self.epoch = int(epoch)
+        self.live: Tuple[int, ...] = tuple(sorted(int(r) for r in live))
+        self.world = int(world)
+
+    @property
+    def degraded(self) -> bool:
+        return len(self.live) < self.world
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Membership(epoch={self.epoch}, live={self.live}, world={self.world})"
+
+
+_MEMBERSHIPS: Dict[Any, Membership] = {}
+
+
+def _full_world() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def current_membership() -> Membership:
+    """This domain's membership (lazily the full-world epoch-0 one)."""
+    key = _current_domain()
+    with _STATE_LOCK:
+        m = _MEMBERSHIPS.get(key)
+        if m is None:
+            world = _full_world()
+            m = Membership(0, range(world), world)
+            _MEMBERSHIPS[key] = m
+        return m
+
+
+def membership_epoch() -> int:
+    """The current membership epoch (0 until a quorum transition happens) —
+    a symmetric input: every live rank agreed on it by negotiation."""
+    m = _MEMBERSHIPS.get(_current_domain())
+    return 0 if m is None else m.epoch
+
+
+def live_ranks() -> Tuple[int, ...]:
+    """The negotiated live-rank tuple (all ranks until a transition)."""
+    m = _MEMBERSHIPS.get(_current_domain())
+    return tuple(range(_full_world())) if m is None else m.live
+
+
+def live_count() -> int:
+    """``len(live_ranks())`` without materializing the tuple twice."""
+    m = _MEMBERSHIPS.get(_current_domain())
+    return _full_world() if m is None else len(m.live)
+
+
+def effective_world() -> int:
+    """World size the payload gathers run over: the full process count on
+    the non-degraded fast path (bit-identical to pre-quorum sync), the
+    survivor count once a quorum transition shrank the membership."""
+    m = _MEMBERSHIPS.get(_current_domain())
+    if m is None or not m.degraded:
+        return _full_world()
+    return len(m.live)
+
+
+def advance_membership(live: Any, epoch: int, reason: str = "shrink") -> Membership:
+    """Install the negotiated ``(epoch, live)`` membership for this domain.
+
+    Epoch-guarded and idempotent: a proposal at or below the current epoch
+    is a no-op returning the installed membership (two code paths racing to
+    install the same agreed transition commit it once). Every transition is
+    a typed, journaled event; probation state resets to healthy — the
+    transition IS the recovery action (the channel is re-negotiated over
+    the new live set), which is what makes degradation converge with zero
+    manual ``reset_channel_health()`` calls.
+    """
+    key = _current_domain()
+    with _STATE_LOCK:
+        cur = _MEMBERSHIPS.get(key)
+        world = cur.world if cur is not None else _full_world()
+        cur_epoch = cur.epoch if cur is not None else 0
+        if int(epoch) <= cur_epoch:
+            return cur if cur is not None else Membership(0, range(world), world)
+        prev_live = cur.live if cur is not None else tuple(range(world))
+        m = Membership(epoch, live, world)
+        _MEMBERSHIPS[key] = m
+        shrank = len(m.live) < len(prev_live)
+    bump_process("membership_transitions")
+    if journal.ACTIVE:
+        journal.record(
+            "resilience.membership",
+            label=reason,
+            epoch=m.epoch,
+            live_count=len(m.live),
+            world=m.world,
+            prev_live_count=len(prev_live),
+        )
+    _channel_force_healthy(key)
+    if shrank:
+        _note_shrink(key)
+    return m
+
+
+def reset_membership() -> None:
+    """Drop this domain's membership back to the full-world epoch-0 state
+    (tests; a production fleet restart re-imports the module anyway)."""
+    with _STATE_LOCK:
+        _MEMBERSHIPS.pop(_current_domain(), None)
+
+
+# ---------------------------------------------------------------------------
+# Flap detection: repeated shrinks in a short round window
+# ---------------------------------------------------------------------------
+
+#: A membership that shrinks more than once inside this many sync rounds is
+#: "flapping" — a rank oscillating between dead and alive, usually a
+#: probation cooldown tuned too short for the failure it keeps readmitting.
+FLAP_WINDOW_ROUNDS = 32
+
+_ROUND_COUNTS: Dict[Any, int] = {}
+_SHRINK_ROUNDS: Dict[Any, List[int]] = {}
+
+
+def note_sync_round() -> None:
+    """Advance this domain's quorum-mode round counter (called once per
+    ``host_sync_state`` entered with ``on_missing="quorum"``) — the clock
+    the flap window is measured in."""
+    key = _current_domain()
+    with _STATE_LOCK:
+        _ROUND_COUNTS[key] = _ROUND_COUNTS.get(key, 0) + 1
+
+
+def _note_shrink(key: Any) -> None:
+    with _STATE_LOCK:
+        round_ = _ROUND_COUNTS.get(key, 0)
+        rounds = _SHRINK_ROUNDS.setdefault(key, [])
+        rounds.append(round_)
+        flapping = (
+            len(rounds) >= 2 and rounds[-1] - rounds[-2] <= FLAP_WINDOW_ROUNDS
+        )
+    if flapping:
+        from metrics_tpu.observability.diagnostics import warn_once
+
+        warn_once(
+            "quorum-flapping",
+            "quorum mode shrank the sync membership more than once within "
+            f"{FLAP_WINDOW_ROUNDS} rounds — a rank is flapping (repeatedly "
+            "readmitted and lost). Lengthen the probation cooldown "
+            "(METRICS_TPU_PROBATION_COOLDOWN_S or "
+            "resilience.configure_probation(base_cooldown_s=...)) so an "
+            "unstable rank stays out longer before it is probed back in.",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Probation: suspect -> cooldown -> probe -> readmit
+# ---------------------------------------------------------------------------
+
+_HEALTHY, _SUSPECT, _PROBE = "healthy", "suspect", "probe"
+
+#: Default cooldown before the first probe round is allowed through; env
+#: knob ``METRICS_TPU_PROBATION_COOLDOWN_S``. Doubled per consecutive
+#: failed probe (exponential backoff), capped at ``max_cooldown_s``.
+DEFAULT_PROBATION_COOLDOWN_S = 60.0
+
+_PROBATION = {
+    "base_cooldown_s": None,  # None -> env knob -> default
+    "max_cooldown_s": 3600.0,
+    "backoff": 2.0,
+}
+
+
+def configure_probation(
+    base_cooldown_s: Optional[float] = None,
+    max_cooldown_s: Optional[float] = None,
+    backoff: Optional[float] = None,
+) -> None:
+    """Override the probation knobs process-wide (tests, tuning loops)."""
+    if base_cooldown_s is not None:
+        _PROBATION["base_cooldown_s"] = float(base_cooldown_s)
+    if max_cooldown_s is not None:
+        _PROBATION["max_cooldown_s"] = float(max_cooldown_s)
+    if backoff is not None:
+        _PROBATION["backoff"] = float(backoff)
+
+
+def _base_cooldown_s() -> float:
+    base = _PROBATION["base_cooldown_s"]
+    if base is not None:
+        return float(base)
+    return float(
+        os.environ.get("METRICS_TPU_PROBATION_COOLDOWN_S", DEFAULT_PROBATION_COOLDOWN_S)
+    )
+
+
+class _ChannelState:
+    __slots__ = ("phase", "failures", "cooldown_until", "episode_started")
+
+    def __init__(self) -> None:
+        self.phase = _HEALTHY
+        self.failures = 0
+        self.cooldown_until = 0.0
+        self.episode_started = 0.0
+
+
+_CHANNELS: Dict[Any, _ChannelState] = {}
+
+
+def _channel(key: Any) -> _ChannelState:
+    st = _CHANNELS.get(key)
+    if st is None:
+        st = _ChannelState()
+        _CHANNELS[key] = st
+    return st
+
+
+def channel_is_suspect() -> bool:
+    """True while the channel is anywhere in probation (suspect OR probing):
+    collective ordering is not yet re-established. The latch-era name is
+    kept — ``parallel/health.py`` re-exports this for existing callers."""
+    st = _CHANNELS.get(_current_domain())
+    return st is not None and st.phase != _HEALTHY
+
+
+def mark_channel_suspect() -> None:
+    """Enter (or re-enter) probation. From healthy this starts a suspect
+    episode with the base cooldown; from a probe round it means the probe
+    FAILED, so the cooldown doubles (exponential backoff, capped). Journals
+    the transition exactly once per episode entry, like the old latch."""
+    key = _current_domain()
+    with _STATE_LOCK:
+        st = _channel(key)
+        if st.phase == _SUSPECT:
+            return
+        failed_probe = st.phase == _PROBE
+        if failed_probe:
+            st.failures += 1
+        else:
+            st.failures = 0
+            st.episode_started = _now()
+        cooldown = min(
+            _base_cooldown_s() * (_PROBATION["backoff"] ** st.failures),
+            _PROBATION["max_cooldown_s"],
+        )
+        st.phase = _SUSPECT
+        st.cooldown_until = _now() + cooldown
+    bump_process("channel_suspect_latched")
+    if journal.ACTIVE:
+        journal.record(
+            "health.channel_suspect",
+            label="probe_failed" if failed_probe else "suspect",
+            cooldown_s=cooldown,
+            failures=st.failures,
+        )
+
+
+def channel_gate() -> str:
+    """The sync path's admission decision: ``"open"`` (healthy — issue
+    collectives normally), ``"refuse"`` (suspect, cooling down — raise the
+    refusal error without touching the channel), or ``"probe"`` (cooldown
+    elapsed — let exactly this sync through as the probe round; its success
+    readmits the channel, its failure re-suspects with doubled backoff)."""
+    key = _current_domain()
+    with _STATE_LOCK:
+        st = _CHANNELS.get(key)
+        if st is None or st.phase == _HEALTHY:
+            return "open"
+        if st.phase == _PROBE:
+            return "probe"
+        if _now() < st.cooldown_until:
+            return "refuse"
+        st.phase = _PROBE
+    if journal.ACTIVE:
+        journal.record("health.channel_probe", failures=st.failures)
+    return "probe"
+
+
+def channel_probe_succeeded() -> None:
+    """A probe round's collectives completed: readmit the channel. Records
+    the episode duration into the ``suspect_episode_s`` telemetry gauge and
+    journals the readmission."""
+    key = _current_domain()
+    with _STATE_LOCK:
+        st = _CHANNELS.get(key)
+        if st is None or st.phase != _PROBE:
+            return
+        episode_s = max(0.0, _now() - st.episode_started)
+        failures = st.failures
+        st.phase = _HEALTHY
+        st.failures = 0
+    add_process("suspect_episode_s", episode_s)
+    bump_process("channel_readmits")
+    if journal.ACTIVE:
+        journal.record("health.channel_readmit", episode_s=episode_s, failures=failures)
+
+
+def _channel_force_healthy(key: Any) -> None:
+    """Silently drop probation state (membership transitions re-establish
+    the channel over the new live set, which IS the recovery)."""
+    with _STATE_LOCK:
+        st = _CHANNELS.get(key)
+        if st is not None:
+            st.phase = _HEALTHY
+            st.failures = 0
+
+
+def reset_channel_health() -> None:
+    """Force the channel healthy — the latch-era manual recovery hook, kept
+    for operators that re-established the process group out of band (and
+    for test fixtures). Probation makes calling it optional, not wrong."""
+    key = _current_domain()
+    with _STATE_LOCK:
+        st = _CHANNELS.get(key)
+        if st is None or st.phase == _HEALTHY:
+            return
+        st.phase = _HEALTHY
+        st.failures = 0
+    bump_process("channel_resets")
+    if journal.ACTIVE:
+        journal.record("health.channel_reset")
+
+
+# ---------------------------------------------------------------------------
+# Quorum transport + negotiation
+# ---------------------------------------------------------------------------
+
+#: Installed transport (None in production until a deployment provides one).
+#: Duck-typed: ``probe() -> iterable[int]`` (ranks currently reachable,
+#: self included), ``negotiate_allgather(vec, live) -> [len(live), k]``
+#: int array, ``subset_allgather(x, live) -> [len(live), ...]`` array.
+_TRANSPORT: Optional[Any] = None
+
+
+def set_quorum_transport(transport: Optional[Any]) -> None:
+    """Install (or clear, with ``None``) the subset-collective transport
+    quorum negotiation rides on. Simulated fleets install theirs in tests;
+    production backends can wrap a coordinator side channel."""
+    global _TRANSPORT
+    _TRANSPORT = transport
+
+
+def active_subset_transport() -> Optional[Callable[[Any], Any]]:
+    """The payload-gather routing hook: ``None`` on the non-degraded fast
+    path (callers use the full-world collective, bit-identical to the
+    pre-quorum sync), else a closure gathering over the survivor set."""
+    m = _MEMBERSHIPS.get(_current_domain())
+    if m is None or not m.degraded or _TRANSPORT is None:
+        return None
+    live = m.live
+    transport = _TRANSPORT
+    return lambda x: transport.subset_allgather(x, frozenset(live))
+
+
+def is_missing_rank_error(err: BaseException) -> bool:
+    """Is this sync failure in the missing-rank class quorum mode handles?
+
+    Watchdog timeouts and dead transports always are; a divergent header
+    (``StateDivergenceError``) is *possibly* one — a dead rank cannot
+    contribute a word, but so does a software-skew divergence between live
+    ranks — which is why :func:`negotiate_quorum` probes before shrinking
+    and falls through when nobody is actually missing.
+    """
+    return isinstance(err, (SyncTimeoutError, StateDivergenceError))
+
+
+def _no_transport_warning() -> None:
+    from metrics_tpu.observability.diagnostics import warn_once
+
+    warn_once(
+        "quorum-no-transport",
+        "on_missing='quorum' requested but no quorum transport is installed "
+        "(resilience.set_quorum_transport) — the full-world collective "
+        "cannot shrink, so the failure falls through to the on_error "
+        "policy unchanged.",
+    )
+
+
+# Negotiation is symmetric by construction: every live rank probes the same
+# fleet state, proposes local_epoch+1 over the SAME survivor set, and takes
+# max() of the gathered proposals — deterministic over identical input, the
+# same contract verify_health_words relies on.
+def negotiate_quorum(
+    err: BaseException, *, metric_name: str = "metric"
+) -> Optional[Membership]:
+    """Shrink the membership after a missing-rank sync failure.
+
+    Returns the newly agreed membership when ranks are actually missing, or
+    ``None`` when quorum cannot help (no transport, nobody missing, or the
+    probe shows the full current membership alive — e.g. a genuine schema
+    divergence between live ranks) — the caller then falls through to the
+    ``on_error`` ladder exactly as before quorum mode existed.
+    """
+    if _TRANSPORT is None:
+        _no_transport_warning()
+        return None
+    cur = current_membership()
+    try:
+        reachable = set(int(r) for r in _TRANSPORT.probe())
+    except Exception:
+        return None
+    live = sorted(reachable & set(cur.live))
+    if not live or set(live) == set(cur.live):
+        return None
+    proposal = np.asarray([cur.epoch + 1, len(live)], dtype=np.int32)
+    try:
+        agreed = np.asarray(
+            _TRANSPORT.negotiate_allgather(proposal, frozenset(live))
+        )
+    except SyncError:
+        return None
+    if agreed.shape[0] != len(live) or not (agreed[:, 1] == len(live)).all():
+        raise StateDivergenceError(
+            f"quorum negotiation for {metric_name} diverged: survivors "
+            f"disagree on the live set (counts {agreed[:, 1].tolist()} vs "
+            f"local {len(live)}). All probing ranks raised together."
+        )
+    epoch = int(agreed[:, 0].max())
+    m = advance_membership(live, epoch, reason="shrink")
+    bump_process("quorum_shrinks")
+    if journal.ACTIVE:
+        journal.record(
+            "resilience.quorum",
+            label=metric_name,
+            epoch=m.epoch,
+            live_count=len(m.live),
+            error=type(err).__name__,
+        )
+    return m
+
+
+def maybe_rejoin(*, metric_name: str = "metric") -> Optional[Membership]:
+    """Grow a degraded membership back when lost ranks are reachable again.
+
+    Called at the top of every quorum-mode sync: survivors and a recovered
+    rank each probe, see the same reachable superset, and negotiate the
+    next epoch over it (max of proposals — a readmitted rank whose local
+    epoch lags still lands on the agreed value). The readmitted rank's
+    accumulated local state simply participates in the next gather, so it
+    catches up through the same ``merge_states`` fold every sync applies.
+    Returns the grown membership, or ``None`` when nothing changed (the
+    overwhelmingly common case — one dict lookup and no collectives on the
+    non-degraded fast path).
+    """
+    m = _MEMBERSHIPS.get(_current_domain())
+    if m is None or not m.degraded or _TRANSPORT is None:
+        return None
+    try:
+        reachable = set(int(r) for r in _TRANSPORT.probe())
+    except Exception:
+        return None
+    grown = sorted(reachable | set(m.live)) if reachable > set(m.live) else None
+    if grown is None:
+        return None
+    proposal = np.asarray([m.epoch + 1, len(grown)], dtype=np.int32)
+    try:
+        agreed = np.asarray(
+            _TRANSPORT.negotiate_allgather(proposal, frozenset(grown))
+        )
+    except SyncError:
+        # a candidate fell away mid-negotiation: stay degraded, next sync
+        # probes again — rejoin is opportunistic, never load-bearing
+        return None
+    if agreed.shape[0] != len(grown) or not (agreed[:, 1] == len(grown)).all():
+        return None
+    epoch = int(agreed[:, 0].max())
+    new = advance_membership(grown, epoch, reason="readmit")
+    bump_process("quorum_readmits")
+    if journal.ACTIVE:
+        journal.record(
+            "resilience.quorum",
+            label=metric_name,
+            epoch=new.epoch,
+            live_count=len(new.live),
+            error="",
+        )
+    return new
+
+
+# ---------------------------------------------------------------------------
+# Adaptive controller: telemetry-driven watchdog + schedule tuning
+# ---------------------------------------------------------------------------
+
+#: Controller-installed watchdog timeout; consulted by
+#: ``health.get_sync_timeout`` between the explicit override and the env
+#: knob. None until a controller commits one.
+_ADAPTIVE_TIMEOUT_S: Optional[float] = None
+
+#: Last committed schedule decisions, keyed by decision kind — inspection
+#: surface for tests and dashboards ("what is the controller doing?").
+_SCHEDULE_DECISIONS: Dict[str, Dict[str, Any]] = {}
+
+
+def adaptive_sync_timeout() -> Optional[float]:
+    """The controller's current watchdog bound (None = not tuning)."""
+    return _ADAPTIVE_TIMEOUT_S
+
+
+def _set_adaptive_timeout(value: Optional[float]) -> None:
+    global _ADAPTIVE_TIMEOUT_S
+    _ADAPTIVE_TIMEOUT_S = value
+
+
+def commit_schedule_decision(
+    kind: str, value: Any, *, epoch: int, reason: str = ""
+) -> Any:
+    """THE choke point for controller decisions that change the collective
+    schedule (sync cadence, staleness policy). ``metricslint``'s schedule
+    pass verifies every value flowing in here derives only from symmetric
+    inputs (membership epoch, health-word columns) — a rank-local tuning
+    decision that changed the schedule would be exactly the divergence
+    class the health word exists to catch. Journals the decision and
+    records it for :func:`last_schedule_decisions`; returns ``value``.
+    """
+    with _STATE_LOCK:
+        _SCHEDULE_DECISIONS[kind] = {"value": value, "epoch": int(epoch), "reason": reason}
+    if journal.ACTIVE:
+        journal.record(
+            "controller.schedule", label=kind, value=value, epoch=int(epoch),
+            reason=reason,
+        )
+    return value
+
+
+def last_schedule_decisions() -> Dict[str, Dict[str, Any]]:
+    with _STATE_LOCK:
+        return {k: dict(v) for k, v in _SCHEDULE_DECISIONS.items()}
+
+
+class AdaptiveController:
+    """Telemetry-subscribed tuner for the sync liveness/schedule knobs.
+
+    Subscribes to the ``sync``, ``health`` and ``resilience`` journal
+    classes (:func:`observability.on_event`) and maintains an EWMA of observed
+    gather wall-clock (``sync.resolve``'s ``gather_s`` field, plus the
+    ``health.margin`` events the watchdog emits on successful guarded
+    collectives). The watchdog timeout recommendation is
+    ``max(floor_s, multiplier * ewma)`` — committed through
+    :func:`adaptive_sync_timeout` (journaled as ``controller.timeout``)
+    whenever it moves by more than ``hysteresis`` relative. Watchdog
+    *pressure* (a fired watchdog, or margins below 25% of the bound) raises
+    the recommendation immediately.
+
+    Schedule-affecting recommendations (cadence back-off while the
+    membership is degraded, pinning ``staleness_policy="snapshot"`` while
+    overlapped rounds resolve stale under pressure) flow through
+    :func:`commit_schedule_decision` with the membership epoch as input —
+    the symmetric-input contract the lint pass enforces.
+
+    Every decision is revertible: :meth:`revert` clears the adaptive
+    timeout and committed decisions, journaling ``controller.revert``.
+    """
+
+    def __init__(
+        self,
+        *,
+        floor_s: float = 5.0,
+        multiplier: float = 8.0,
+        alpha: float = 0.2,
+        hysteresis: float = 0.25,
+    ) -> None:
+        self.floor_s = float(floor_s)
+        self.multiplier = float(multiplier)
+        self.alpha = float(alpha)
+        self.hysteresis = float(hysteresis)
+        self.ewma_gather_s: Optional[float] = None
+        self._subscription: Optional[Any] = None
+        self._lock = threading.Lock()
+
+    def start(self) -> "AdaptiveController":
+        if self._subscription is None:
+            self._subscription = journal.on_event(
+                self._on_event, classes=("sync", "health", "resilience")
+            )
+        return self
+
+    def stop(self) -> None:
+        sub = self._subscription
+        self._subscription = None
+        if sub is not None:
+            sub.close()
+
+    # -- event plumbing ----------------------------------------------------
+
+    def _on_event(self, event: Any) -> None:
+        kind = event.kind
+        if kind in ("sync.resolve", "health.margin"):
+            gather_s = event.fields.get("gather_s")
+            if gather_s is None and kind == "health.margin":
+                gather_s = event.fields.get("elapsed_s")
+            if gather_s is not None and float(gather_s) > 0:
+                self._observe_gather(float(gather_s))
+        elif kind == "health.watchdog":
+            self._on_watchdog_fired(float(event.fields.get("timeout_s", 0.0)))
+        elif kind == "resilience.membership":
+            self._on_membership(event.fields)
+
+    def _observe_gather(self, gather_s: float) -> None:
+        with self._lock:
+            if self.ewma_gather_s is None:
+                self.ewma_gather_s = gather_s
+            else:
+                self.ewma_gather_s += self.alpha * (gather_s - self.ewma_gather_s)
+            recommended = max(self.floor_s, self.multiplier * self.ewma_gather_s)
+            current = adaptive_sync_timeout()
+            move = (
+                abs(recommended - current) / current if current else float("inf")
+            )
+        if move > self.hysteresis:
+            self._commit_timeout(recommended, reason="ewma")
+
+    def _on_watchdog_fired(self, fired_timeout_s: float) -> None:
+        # pressure: the bound was too tight (or a peer is dead — either way
+        # a tighter bound cannot help); back off immediately
+        current = adaptive_sync_timeout()
+        if current is not None and fired_timeout_s and current <= fired_timeout_s:
+            self._commit_timeout(current * 2.0, reason="watchdog_pressure")
+
+    def _on_membership(self, event: Dict[str, Any]) -> None:
+        # schedule decision from symmetric inputs only: the negotiated
+        # membership epoch (identical on every live rank by construction)
+        epoch = int(event.get("epoch", 0))
+        degraded = int(event.get("live_count", 0)) < int(event.get("world", 0))
+        commit_schedule_decision(
+            "sync_cadence_multiplier",
+            2 if degraded else 1,
+            epoch=epoch,
+            reason="degraded membership" if degraded else "membership restored",
+        )
+        commit_schedule_decision(
+            "staleness_policy",
+            "snapshot",
+            epoch=epoch,
+            reason="pin consistent snapshot serving across a membership change",
+        )
+
+    def _commit_timeout(self, value: float, reason: str) -> None:
+        _set_adaptive_timeout(float(value))
+        set_process("adaptive_timeout_s", float(value))
+        if journal.ACTIVE:
+            journal.record(
+                "controller.timeout", label=reason, timeout_s=float(value),
+                ewma_gather_s=self.ewma_gather_s or 0.0,
+            )
+
+    def revert(self) -> None:
+        """Undo every committed decision (journaled): adaptive timeout off,
+        schedule decisions cleared — the escape hatch the issue requires."""
+        _set_adaptive_timeout(None)
+        with _STATE_LOCK:
+            _SCHEDULE_DECISIONS.clear()
+        if journal.ACTIVE:
+            journal.record("controller.revert")
+
+
+# ---------------------------------------------------------------------------
+# test/fixture hygiene
+# ---------------------------------------------------------------------------
+
+
+def reset_resilience() -> None:
+    """Drop ALL per-domain resilience state (memberships, probation,
+    flap windows, adaptive decisions, transport) — fixture teardown for
+    simulated fleets; production code never calls this."""
+    global _TRANSPORT
+    with _STATE_LOCK:
+        _MEMBERSHIPS.clear()
+        _CHANNELS.clear()
+        _ROUND_COUNTS.clear()
+        _SHRINK_ROUNDS.clear()
+        _SCHEDULE_DECISIONS.clear()
+    _TRANSPORT = None
+    _set_adaptive_timeout(None)

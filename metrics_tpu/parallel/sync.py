@@ -196,11 +196,21 @@ def _raw_process_allgather(x: Array) -> Array:
 def _process_allgather(x: Array, timeout: Optional[float] = None) -> Array:
     """Watchdog-guarded ``process_allgather``: raises
     :class:`~metrics_tpu.utils.exceptions.SyncTimeoutError` instead of
-    blocking forever on a dead/stalled peer."""
-    from metrics_tpu.parallel.health import call_with_sync_watchdog
+    blocking forever on a dead/stalled peer.
 
+    On the non-degraded fast path this is exactly the full-world collective.
+    Once a quorum transition shrank the membership
+    (``parallel/resilience.py``), the gather routes through the installed
+    subset transport instead — same watchdog, same call shape, but issued
+    over the survivor set only.
+    """
+    from metrics_tpu.parallel.health import call_with_sync_watchdog
+    from metrics_tpu.parallel.resilience import active_subset_transport
+
+    subset = active_subset_transport()
+    gather = _raw_process_allgather if subset is None else subset
     return call_with_sync_watchdog(
-        lambda: _raw_process_allgather(x), timeout=timeout, what="process_allgather"
+        lambda: gather(x), timeout=timeout, what="process_allgather"
     )
 
 
@@ -221,8 +231,10 @@ def gather_all_arrays(
     header, and reduce-style leaves have schema-verified static shapes —
     skip the shape pre-gather entirely, saving one collective per call.
     """
+    from metrics_tpu.parallel.resilience import effective_world
+
     result = jnp.asarray(result)
-    world = jax.process_count()
+    world = effective_world()
     if world == 1:
         return [result]
     if all_shapes is None:
@@ -271,7 +283,9 @@ def host_sync_leaf(
     if isinstance(value, CatBuffer):
         if not jit_distributed_available():
             return value.copy()
-        world = jax.process_count()
+        from metrics_tpu.parallel.resilience import effective_world
+
+        world = effective_world()
         if precheck:
             # packed (count, overflow-flag) word: one collective for both
             # symmetric checks instead of the historical two
@@ -323,10 +337,12 @@ def host_sync_leaf(
     value = jnp.asarray(value)
     known_shapes = None
     if not precheck and fx not in ("cat", None):
+        from metrics_tpu.parallel.resilience import effective_world
+
         # the caller verified the sync header, whose schema hash covers the
         # FULL shape of reduce/callable-fx leaves — every rank's shape is
         # known-equal, so the shape pre-gather would be a redundant collective
-        known_shapes = np.tile(np.asarray(value.shape, np.int32), (jax.process_count(), 1))
+        known_shapes = np.tile(np.asarray(value.shape, np.int32), (effective_world(), 1))
     pieces = gather_all_arrays(value, timeout=timeout, all_shapes=known_shapes)
     if fx == "cat" or fx is None:
         return jnp.concatenate([p[None] if p.ndim == 0 else p for p in pieces], axis=0)
@@ -360,6 +376,7 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
     metric_name: str = "metric",
     fused: Optional[bool] = None,
     sync_epoch: int = 0,
+    on_missing: str = "raise",
 ) -> Dict[str, Any]:
     """Host-path sync of a whole metric-state dict across processes.
 
@@ -388,19 +405,34 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
     can never pair its collectives with a peer's foreground sync
     (``parallel/async_sync.py`` sets it per round).
 
+    ``on_missing`` decides what a *missing-rank* failure (watchdog timeout,
+    dead transport, divergent header) means: ``"raise"`` (default, the
+    pre-quorum behavior — the typed error propagates to the ``on_error``
+    ladder), ``"quorum"`` (negotiate a shrunken membership over the
+    survivors via ``parallel/resilience.py`` and re-run the health-checked
+    gather over the survivor set only — bit-identical to the default when
+    every rank is live), or ``"local"`` (the caller degrades to local state
+    for missing-rank failures regardless of ``on_error`` — threaded by
+    ``Metric._handle_sync_failure``; this function treats it like
+    ``"raise"``).
+
     Once a watchdog has fired anywhere in the process, the cross-process
     channel is *suspect* (the abandoned worker may still sit inside the
     timed-out gather, so a fresh collective could pair with a peer's stale
     one and return wrong data without erroring) — further syncs raise
     :class:`~metrics_tpu.utils.exceptions.SyncTimeoutError` immediately,
-    before issuing any collective, until
-    :func:`~metrics_tpu.parallel.health.reset_channel_health`.
+    before issuing any collective, while the probation machine
+    (``parallel/resilience.py``) cools the channel down; once the cooldown
+    elapses one sync is admitted as the *probe round*, and its success
+    readmits the channel automatically (``reset_channel_health`` remains
+    the manual override).
     """
     if not jit_distributed_available():
         return {name: host_sync_leaf(value, reductions.get(name)) for name, value in state.items()}
     from metrics_tpu.observability import journal
+    from metrics_tpu.parallel import resilience
     from metrics_tpu.parallel.async_sync import sync_channel
-    from metrics_tpu.parallel.health import channel_is_suspect
+    from metrics_tpu.utils.exceptions import SyncError
 
     if journal.ACTIVE:
         journal.record(
@@ -408,22 +440,21 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
             states=len(state), fused=fused,
         )
 
-    if channel_is_suspect():
+    gate = resilience.channel_gate()
+    if gate == "refuse":
         from metrics_tpu.utils.exceptions import SyncTimeoutError
 
         raise SyncTimeoutError(
             f"host sync of {metric_name} refused: an earlier collective timed "
             "out, so cross-process collective ordering can no longer be "
             "trusted (a new gather could silently pair with a peer's stale "
-            "one). Recover with on_error='local' degradation, or restart the "
-            "process group and call "
+            "one). Recover with on_error='local' degradation; the channel "
+            "will admit a probe round after its probation cooldown, or "
+            "restart the process group and call "
             "metrics_tpu.parallel.health.reset_channel_health()."
         )
-    # the channel guard orders this whole sync after any in-flight
-    # background round (``parallel/async_sync.py``): a foreground sync first
-    # drains rounds already launched on every rank (program order is SPMD-
-    # identical, so the global collective order stays deterministic)
-    with sync_channel():
+
+    def _attempt() -> Dict[str, Any]:
         precheck = True
         if check_health:
             from metrics_tpu.parallel.health import build_health_word, verify_health_words
@@ -442,11 +473,39 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
             precheck = False
             from metrics_tpu.parallel.bucketing import fused_sync_enabled, host_sync_state_bucketed
 
-            if fused is None:
-                fused = fused_sync_enabled()
-            if fused:
+            if fused_sync_enabled() if fused is None else fused:
                 return host_sync_state_bucketed(state, reductions, words=words, timeout=timeout)
         return {
             name: host_sync_leaf(value, reductions.get(name), precheck=precheck, timeout=timeout)
             for name, value in state.items()
         }
+
+    # the channel guard orders this whole sync after any in-flight
+    # background round (``parallel/async_sync.py``): a foreground sync first
+    # drains rounds already launched on every rank (program order is SPMD-
+    # identical, so the global collective order stays deterministic)
+    with sync_channel():
+        if on_missing == "quorum":
+            resilience.note_sync_round()
+            resilience.maybe_rejoin(metric_name=metric_name)
+        try:
+            synced = _attempt()
+        except Exception as err:
+            if (
+                on_missing == "quorum"
+                and isinstance(err, SyncError)
+                and resilience.is_missing_rank_error(err)
+                and resilience.negotiate_quorum(err, metric_name=metric_name) is not None
+            ):
+                # membership shrank: re-run the full health-checked gather
+                # over the survivor set. Safe in a handler: negotiate_quorum
+                # already re-established symmetry — every survivor ran the
+                # same negotiation and agreed the same membership epoch, the
+                # header re-verifies it, and payload collectives route
+                # through the survivor-set transport.
+                synced = _attempt()  # metricslint: disable=collective-in-handler
+            else:
+                raise
+    if gate == "probe":
+        resilience.channel_probe_succeeded()
+    return synced

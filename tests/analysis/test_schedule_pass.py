@@ -220,3 +220,64 @@ def test_shipped_package_emission_sites_are_guard_free():
     src = open(ckpt_path).read().replace("# metricslint: disable", "# stripped")
     resurfaced = analyze_source(src, ckpt_path)
     assert any(f.rule == "guarded-telemetry-emit" for f in resurfaced)
+
+
+def test_controller_fixture_covers_asymmetric_schedule_decision():
+    owners = by_function(findings_for("violating_controller.py"))
+    assert owners["rank_dependent_cadence"] == {"asymmetric-schedule-decision"}
+    assert owners["rank_derived_timeout"] == {"asymmetric-schedule-decision"}
+    assert owners["data_dependent_policy"] == {"asymmetric-schedule-decision"}
+    assert owners["latch_governed_decision"] == {"asymmetric-schedule-decision"}
+    # symmetric inputs (world size, EWMA of journal-observed gather times)
+    # commit cleanly
+    assert "clean_symmetric_decision" not in owners
+
+
+def test_schedule_decision_value_taint_is_flagged():
+    """A decision VALUE derived from local data is flagged even with no
+    tainted guard anywhere near the commit."""
+    src = '''
+def straight_line_commit(state):
+    cadence = 1 + len(state)
+    commit_schedule_decision("sync_cadence_multiplier", cadence, epoch=1, reason="x")
+'''
+    findings = run_schedule_pass(ast.parse(src), "<s>")
+    assert by_function(findings)["straight_line_commit"] == {
+        "asymmetric-schedule-decision"
+    }
+
+
+def test_probation_gate_is_local_and_membership_readers_are_symmetric():
+    """channel_gate() reads the per-process probation machine (local taint:
+    a collective guarded on it is flagged); effective_world()/
+    membership_epoch() are negotiated symmetric facts (branching on them is
+    clean)."""
+    src = '''
+def _process_allgather(x, timeout=None):
+    return x
+
+def gate_guarded_gather(x):
+    if channel_gate() == "open":
+        return _process_allgather(x)
+    return x
+
+def membership_guarded_gather(x):
+    if effective_world() > 1 and membership_epoch() > 0:
+        return _process_allgather(x)
+    return x
+'''
+    findings = run_schedule_pass(ast.parse(src), "<s>")
+    owners = by_function(findings)
+    assert owners["gate_guarded_gather"] == {"data-dependent-collective"}
+    assert "membership_guarded_gather" not in owners
+
+
+def test_shipped_resilience_module_verifies():
+    """The adaptive controller the runtime ships commits every decision from
+    symmetric inputs — the new rule passes over parallel/resilience.py."""
+    import metrics_tpu
+
+    pkg = os.path.dirname(metrics_tpu.__file__)
+    findings, errors = analyze_paths([os.path.join(pkg, "parallel", "resilience.py")])
+    assert not errors
+    assert [f for f in findings if f.rule == "asymmetric-schedule-decision"] == []

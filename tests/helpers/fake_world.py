@@ -22,14 +22,18 @@ its own background lane whose worker thread *carries the rank's identity*
 thread-local) — monkeypatch it over ``async_sync._get_executor``.
 """
 import threading
-from typing import Any, Callable, Dict, List, Optional
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, FrozenSet, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.parallel.async_sync import SerialExecutor
+from metrics_tpu.utils.exceptions import SyncTimeoutError
 
-__all__ = ["LockstepWorld"]
+__all__ = ["FaultProfile", "FleetWorld", "LockstepWorld", "RankPreempted"]
 
 
 class LockstepWorld:
@@ -134,3 +138,392 @@ class LockstepWorld:
             if err is not None:
                 raise err
         return results
+
+
+class RankPreempted(BaseException):
+    """A simulated rank was preempted mid-step.
+
+    Derives ``BaseException`` so it sails through the library's
+    ``except Exception`` fallback handlers the way a real SIGTERM would —
+    the sync stack must never convert a preemption into a "handled" error.
+    """
+
+    def __init__(self, rank: int, step: int) -> None:
+        super().__init__(f"rank {rank} preempted at step {step}")
+        self.rank = rank
+        self.step = step
+
+
+@dataclass(frozen=True)
+class FaultProfile:
+    """Declarative fault/latency profile for a :class:`FleetWorld`.
+
+    All randomness is derived from ``seed`` via ``zlib.crc32`` so a profile
+    replays bit-identically across runs and platforms — no RNG state.
+
+    - ``tier_size``: ranks ``[k*tier_size, (k+1)*tier_size)`` share a tier;
+      a gather whose participant set spans tiers pays ``inter_tier_latency_s``
+      per rank instead of ``intra_tier_latency_s``.
+    - ``preempt_at``: rank -> step at which that rank is permanently
+      preempted (raises :class:`RankPreempted` from ``begin_round``).
+    - ``preempt_hazard``: per-(rank, step) permanent-preemption probability.
+    - ``straggler_ranks`` / ``straggler_delay_s``: fixed extra delay those
+      ranks add before contributing to every gather.
+    - ``drop_rounds``: rank -> (start_step, n_steps) transient partition:
+      during rounds ``[start, start + n)`` the rank's gathers fail and
+      peers observe it unreachable; it recovers afterwards. Windows are
+      judged at each observing rank's *own* step (rounds are SPMD-aligned
+      across ranks, wall-clock is not — see :meth:`FleetWorld._in_drop`).
+    """
+
+    tier_size: int = 8
+    intra_tier_latency_s: float = 0.0
+    inter_tier_latency_s: float = 0.0
+    jitter_s: float = 0.0
+    preempt_at: Dict[int, int] = field(default_factory=dict)
+    preempt_hazard: float = 0.0
+    straggler_ranks: Tuple[int, ...] = ()
+    straggler_delay_s: float = 0.0
+    drop_rounds: Dict[int, Tuple[int, int]] = field(default_factory=dict)
+    seed: int = 0
+
+    def __post_init__(self) -> None:
+        # dataclass(frozen) + dict defaults: freeze shallow copies so a
+        # profile shared across worlds cannot be mutated under one of them.
+        object.__setattr__(self, "preempt_at", dict(self.preempt_at))
+        object.__setattr__(self, "drop_rounds", dict(self.drop_rounds))
+        object.__setattr__(self, "straggler_ranks", tuple(self.straggler_ranks))
+
+
+class FleetWorld(LockstepWorld):
+    """Fault-injecting fleet simulator: LockstepWorld grown to W=64..256
+    ranks with a declarative :class:`FaultProfile` and quorum support.
+
+    Differences from the barrier-based parent:
+
+    - Rendezvous is a condition variable keyed by *participant set*, so a
+      degraded survivor set can gather independently of (and concurrently
+      with) a partitioned rank serving its own quorum-of-1.
+    - The world itself is the quorum transport
+      (:meth:`probe` / :meth:`negotiate_allgather` /
+      :meth:`subset_allgather`) — install with :meth:`install`.
+    - A rank that dies (preemption, drop window, real error) makes waiting
+      peers fail *fast* with ``SyncTimeoutError`` instead of burning the
+      watchdog timeout, keeping W=256 simulations cheap.
+
+    Per-(rank, participant-set) round counters are incremented BEFORE any
+    failure check: a failed attempt consumes the same round slot on every
+    rank, so counters stay aligned across failures and readmissions.
+    """
+
+    def __init__(
+        self,
+        world: int = 64,
+        profile: Optional[FaultProfile] = None,
+        gather_timeout_s: float = 10.0,
+    ) -> None:
+        super().__init__(world)
+        self.profile = profile or FaultProfile()
+        self.gather_timeout_s = gather_timeout_s
+        self._full: FrozenSet[int] = frozenset(range(world))
+        self._cv = threading.Condition()
+        self._counters: Dict[Any, int] = {}
+        self._entries: Dict[Any, Dict[str, Any]] = {}
+        self._steps: Dict[int, int] = {}
+        self._dead: set = set()
+        self.preempted: set = set()
+        self.gather_rounds_total = 0
+        self.gather_rounds_degraded = 0
+        self._prev_rank_provider: Optional[Callable[[], int]] = None
+
+    # ------------------------------------------------------------------ #
+    # fault state                                                        #
+    # ------------------------------------------------------------------ #
+
+    def _in_drop(self, rank: int) -> bool:
+        """Is ``rank``'s drop window active, judged at the OBSERVER's step?
+
+        Windows are defined over round indices, and SPMD ranks interpret
+        round indices identically — so judging the window against the
+        *calling* thread's own step (not the dropped rank's) makes every
+        rank's view of "is r partitioned this round" consistent per round,
+        regardless of wall-clock skew between free-running ranks. Judging
+        by the dropped rank's step would let a fast rank exit its window
+        while slow survivors are mid-round, splitting the rejoin
+        negotiation and desynchronizing the per-rank gather counters.
+        """
+        window = self.profile.drop_rounds.get(rank)
+        if window is None:
+            return False
+        start, n_steps = window
+        return start <= self._observer_step() < start + n_steps
+
+    def _observer_step(self) -> int:
+        observer = getattr(self._rank, "value", None)
+        return self._steps.get(observer, -1) if observer is not None else -1
+
+    def _unreachable(self) -> set:
+        """Ranks the CALLING rank cannot currently hear from.
+
+        Scheduled preemptions (``preempt_at``) are judged at the observer's
+        step like drop windows, not by whether the doomed rank has actually
+        executed its fatal ``begin_round`` yet: ranks free-run between
+        rendezvous, so two ranks scheduled to die at the same step die at
+        different *wall* times — judging by execution would let an early
+        prober see one death and a late prober two, splitting the survivor
+        negotiation across two different live sets. Hazard deaths and real
+        errors stay wall-time events (``_dead``), which is the realistic
+        racy case quorum negotiation must tolerate by retrying.
+        """
+        out = set(self._dead)
+        at_step = self._observer_step()
+        for rank, die_step in self.profile.preempt_at.items():
+            if die_step <= at_step:
+                out.add(rank)
+        for rank in self.profile.drop_rounds:
+            if self._in_drop(rank):
+                out.add(rank)
+        return out
+
+    def begin_round(self, rank: int, step: int) -> None:
+        """Advance ``rank`` to ``step``; fire any scheduled/hazard preemption.
+
+        Call at the top of each simulated training step, before any sync.
+        """
+        profile = self.profile
+        with self._cv:
+            self._steps[rank] = step
+            doomed = profile.preempt_at.get(rank) == step
+            if not doomed and profile.preempt_hazard > 0.0:
+                draw = zlib.crc32(f"{profile.seed}:{rank}:{step}".encode()) / 2**32
+                doomed = draw < profile.preempt_hazard
+            if doomed:
+                self._dead.add(rank)
+                self._cv.notify_all()
+                raise RankPreempted(rank, step)
+
+    def _inject_latency(self, rank: int, expected: FrozenSet[int], tag: Any) -> None:
+        profile = self.profile
+        delay = 0.0
+        if rank in profile.straggler_ranks:
+            delay += profile.straggler_delay_s
+        tiers = {r // profile.tier_size for r in expected}
+        delay += (
+            profile.inter_tier_latency_s
+            if len(tiers) > 1
+            else profile.intra_tier_latency_s
+        )
+        if profile.jitter_s > 0.0:
+            token = f"{profile.seed}:{rank}:{self._steps.get(rank, -1)}:{tag}"
+            delay += profile.jitter_s * (zlib.crc32(token.encode()) / 2**32)
+        if delay > 0.0:
+            time.sleep(delay)
+
+    # ------------------------------------------------------------------ #
+    # payload/header gathers (namespace "g")                             #
+    # ------------------------------------------------------------------ #
+
+    def _gather(self, x: Any, expected: FrozenSet[int]):
+        rank = self._rank.value
+        with self._cv:
+            key = (rank, "g", expected)
+            round_idx = self._counters.get(key, 0)
+            # Increment BEFORE any failure check: a failed attempt must
+            # consume the same round slot on every rank or the counters
+            # desynchronize after readmission.
+            self._counters[key] = round_idx + 1
+            if self._in_drop(rank) and expected != frozenset({rank}):
+                raise SyncTimeoutError(
+                    f"[FleetWorld] rank {rank} is partitioned: gather over "
+                    f"{len(expected)} rank(s) did not complete (peers dead or stalled)"
+                )
+        self._inject_latency(rank, expected, round_idx)
+        entry_key = ("g", expected, round_idx)
+        with self._cv:
+            entry = self._entries.setdefault(entry_key, {"vals": {}, "result": None})
+            entry["vals"][rank] = np.asarray(x).copy()
+            if len(entry["vals"]) == len(expected):
+                order = sorted(expected)
+                entry["result"] = np.stack([entry["vals"][r] for r in order])
+                self.calls += 1
+                self.gather_rounds_total += 1
+                if len(expected) < self.world:
+                    self.gather_rounds_degraded += 1
+                self._cv.notify_all()
+            deadline = time.monotonic() + self.gather_timeout_s
+            while entry["result"] is None:
+                missing = expected - set(entry["vals"])
+                unreachable = missing & self._unreachable()
+                if unreachable:
+                    raise SyncTimeoutError(
+                        f"[FleetWorld] gather round {round_idx}: rank(s) "
+                        f"{sorted(unreachable)} dead or stalled; "
+                        f"{len(entry['vals'])}/{len(expected)} contributed"
+                    )
+                if time.monotonic() > deadline:
+                    raise SyncTimeoutError(
+                        f"[FleetWorld] gather round {round_idx} over "
+                        f"{len(expected)} rank(s) did not complete within "
+                        f"{self.gather_timeout_s:.1f}s (dead or stalled peer)"
+                    )
+                self._cv.wait(0.02)
+            out = jnp.asarray(entry["result"])
+            # GC: last reader retires the round so long simulations do not
+            # retain every payload ever gathered.
+            entry["readers"] = entry.get("readers", 0) + 1
+            if entry["readers"] == len(expected):
+                self._entries.pop(entry_key, None)
+            return out
+
+    def allgather(self, x: Any):
+        """Full-world collective — the ``_raw_process_allgather`` seam."""
+        return self._gather(x, self._full)
+
+    # ------------------------------------------------------------------ #
+    # quorum transport (consumed by metrics_tpu.parallel.resilience)     #
+    # ------------------------------------------------------------------ #
+
+    def probe(self):
+        """Ranks this rank can currently reach (including itself)."""
+        rank = self._rank.value
+        with self._cv:
+            if self._in_drop(rank) or rank in self._dead:
+                return (rank,)
+            unreachable = self._unreachable()
+        return tuple(r for r in range(self.world) if r not in unreachable)
+
+    def subset_allgather(self, x: Any, live: FrozenSet[int]):
+        return self._gather(x, frozenset(live))
+
+    def negotiate_allgather(self, vec: Any, live: FrozenSet[int]):
+        """Membership negotiation round over ``live`` (namespace "neg").
+
+        Generation-keyed: entries are keyed by the live *set* only, the
+        last depositor completes the round and bumps the generation, and a
+        rank re-depositing after a timed-out attempt simply overwrites its
+        own slot — re-deposits are idempotent, so a rank whose earlier
+        negotiation attempt expired self-heals on the next attempt.
+        """
+        rank = self._rank.value
+        live = frozenset(live)
+        key = ("neg", live)
+        with self._cv:
+            entry = self._entries.setdefault(
+                key, {"vals": {}, "gen": 0, "result": None}
+            )
+            gen = entry["gen"]
+            entry["vals"][rank] = np.asarray(vec).copy()
+            if set(entry["vals"]) >= live:
+                order = sorted(live)
+                entry["result"] = np.stack([entry["vals"][r] for r in order])
+                entry["gen"] = gen + 1
+                entry["vals"] = {}
+                self._cv.notify_all()
+                return entry["result"]
+            deadline = time.monotonic() + self.gather_timeout_s
+            while entry["gen"] == gen:
+                missing = live - set(entry["vals"])
+                dead = missing & set(self._dead)
+                if dead:
+                    raise SyncTimeoutError(
+                        f"[FleetWorld] negotiation over {len(live)} rank(s): "
+                        f"rank(s) {sorted(dead)} dead or stalled"
+                    )
+                if time.monotonic() > deadline:
+                    raise SyncTimeoutError(
+                        f"[FleetWorld] negotiation over {len(live)} rank(s) "
+                        f"did not complete within {self.gather_timeout_s:.1f}s"
+                    )
+                self._cv.wait(0.02)
+            return entry["result"]
+
+    # ------------------------------------------------------------------ #
+    # driving                                                            #
+    # ------------------------------------------------------------------ #
+
+    def run(self, fn: Callable[[int], Any], timeout: float = 120.0) -> List[Any]:
+        """Like :meth:`LockstepWorld.run`, but a :class:`RankPreempted`
+        rank is recorded in ``self.preempted`` (not an error), and any
+        *real* error marks the rank dead so peers fail fast instead of
+        deadlocking."""
+        results: List[Any] = [None] * self.world
+        errors: List[Optional[BaseException]] = [None] * self.world
+
+        def body(rank: int) -> None:
+            self._rank.value = rank
+            try:
+                results[rank] = fn(rank)
+            except RankPreempted:
+                with self._cv:
+                    self.preempted.add(rank)
+                    self._dead.add(rank)
+                    self._cv.notify_all()
+            except BaseException as err:  # noqa: BLE001 - re-raised below
+                errors[rank] = err
+                with self._cv:
+                    self._dead.add(rank)
+                    self._cv.notify_all()
+
+        threads = [
+            threading.Thread(
+                target=body, args=(r,), daemon=True, name=f"fleet-rank{r}"
+            )
+            for r in range(self.world)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout)
+        if any(t.is_alive() for t in threads):
+            with self._cv:
+                self._dead.update(range(self.world))
+                self._cv.notify_all()
+            raise RuntimeError(
+                "FleetWorld deadlocked: a rank never finished its rounds"
+            )
+        for err in errors:
+            if err is not None:
+                raise err
+        return results
+
+    # ------------------------------------------------------------------ #
+    # installation                                                       #
+    # ------------------------------------------------------------------ #
+
+    def install(self, monkeypatch) -> "FleetWorld":
+        """Wire this world over every seam the sync stack reaches through.
+
+        ``reset_resilience()`` runs FIRST (it clears any installed
+        transport), then the monkeypatched seams, then this world is
+        registered as the quorum transport. Pair with :meth:`uninstall`
+        in teardown — the journal rank provider and the transport are
+        process-global, not monkeypatch-scoped.
+        """
+        import jax
+
+        from metrics_tpu.observability import journal
+        from metrics_tpu.parallel import async_sync as async_mod
+        from metrics_tpu.parallel import resilience
+        from metrics_tpu.parallel import sync as sync_mod
+
+        resilience.reset_resilience()
+        monkeypatch.setattr(jax, "process_count", lambda: self.world)
+        monkeypatch.setattr(sync_mod, "_raw_process_allgather", self.allgather)
+        monkeypatch.setattr(async_mod, "_get_executor", self.executor_for_current_rank)
+        monkeypatch.setattr(async_mod, "_current_domain", self.rank_domain)
+        monkeypatch.setattr(resilience, "_current_domain", self.rank_domain)
+        resilience.set_quorum_transport(self)
+        self._prev_rank_provider = journal.set_rank_provider(
+            lambda: self.rank_domain() or 0
+        )
+        return self
+
+    def uninstall(self) -> None:
+        from metrics_tpu.observability import journal
+        from metrics_tpu.parallel import resilience
+
+        resilience.reset_resilience()
+        if self._prev_rank_provider is not None:
+            journal.set_rank_provider(self._prev_rank_provider)
+            self._prev_rank_provider = None
+        self.shutdown_executors()

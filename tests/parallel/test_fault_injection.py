@@ -786,3 +786,156 @@ def test_initialize_retry_exhaustion_is_typed_and_chained():
             initialize_fn=always_down,
         )
     assert isinstance(ei.value.__cause__, RuntimeError)
+
+
+# ---------------------------------------------------------------------------
+# on_missing matrix (ISSUE 16): the missing-rank policy composes with the
+# on_error ladder — "raise" keeps the pre-quorum behavior bit-for-bit,
+# "local" degrades ONLY the missing-rank class, "quorum" shrinks the
+# membership over an installed transport and re-runs the gather.
+# The fleet-scale end-to-end complement lives in test_resilience.py.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience():
+    from metrics_tpu.parallel import resilience
+
+    resilience.reset_resilience()
+    yield
+    resilience.reset_resilience()
+
+
+class _EchoTransport:
+    """Quorum transport over the Echo world: ``probe()`` reports ``live``
+    (mutable — a scenario script), and negotiation/subset gathers echo this
+    rank's contribution for every live peer (symmetric agreement)."""
+
+    def __init__(self, live=(0,)):
+        self.live = tuple(live)
+        self.subset_calls = 0
+
+    def probe(self):
+        return self.live
+
+    def negotiate_allgather(self, vec, live):
+        return np.stack([np.asarray(vec)] * len(live))
+
+    def subset_allgather(self, x, live):
+        self.subset_calls += 1
+        return jnp.asarray(np.stack([np.asarray(x)] * len(live)))
+
+
+def test_on_missing_validation():
+    from metrics_tpu.core.metric import Metric  # noqa: F401 - import check
+
+    with pytest.raises(MetricsTPUUserError, match="sync_on_missing"):
+        DummyMetricSum(sync_on_missing="bogus")
+    m = DummyMetricSum()
+    with pytest.raises(MetricsTPUUserError, match="on_missing"):
+        m.sync(on_missing="bogus", distributed_available=lambda: True)
+
+
+def test_on_missing_local_degrades_dead_rank_only(fake_world):
+    # a dead peer degrades to local state WITHOUT on_error="local" ...
+    m = _distributed_metric(fake_world, EchoAllgather(delay_s=3.0))
+    m.sync_on_missing = "local"
+    m.update(jnp.asarray(1.0))
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        m.sync(timeout=0.2)
+    assert not m._is_synced and m._sync_degraded
+    np.testing.assert_allclose(np.asarray(m.x), 1.0)
+
+
+def test_on_missing_local_still_raises_non_missing_errors(fake_world):
+    # ... but a poisoned peer is NOT a missing rank: the typed raise stands
+    def poison(word):
+        word[_F_NONFINITE] = 1
+        return word
+
+    m = _distributed_metric(fake_world, EchoAllgather(mutate_first=poison))
+    m.sync_on_missing = "local"
+    m.update(jnp.asarray(1.0))
+    with pytest.raises(NonFiniteStateError):
+        m.sync(timeout=0.2)
+
+
+def test_on_missing_quorum_without_transport_falls_through(fake_world):
+    from metrics_tpu.observability import diagnostics
+
+    diagnostics.reset("quorum-no-transport")
+    fake_world(EchoAllgather(delay_s=3.0))
+    state, reds = _sum_state()
+    with pytest.raises(SyncTimeoutError, match="dead or stalled"):
+        host_sync_state(state, reds, timeout=0.2, on_missing="quorum")
+    assert diagnostics.seen("quorum-no-transport")
+    diagnostics.reset("quorum-no-transport")
+
+
+def test_on_missing_quorum_shrinks_dead_rank_to_survivors(fake_world):
+    from metrics_tpu.parallel import resilience
+
+    transport = _EchoTransport(live=(0,))  # only this rank is reachable
+    resilience.set_quorum_transport(transport)
+    fake_world(EchoAllgather(delay_s=3.0))  # the full-world gather is dead
+    state, reds = _sum_state()
+    out = host_sync_state(state, reds, timeout=0.2, on_missing="quorum")
+    # shrank to a quorum of one and re-ran the gather over the survivor set
+    np.testing.assert_allclose(np.asarray(out["x"]), 1.0)
+    assert resilience.membership_epoch() == 1
+    assert resilience.live_ranks() == (0,)
+    assert resilience.effective_world() == 1
+    assert transport.subset_calls > 0
+    # the quorum retry readmitted the channel: no latched refusal afterwards
+    assert not channel_is_suspect()
+
+
+def test_on_missing_quorum_readmits_recovered_rank(fake_world):
+    from metrics_tpu.parallel import resilience
+
+    transport = _EchoTransport(live=(0,))
+    resilience.set_quorum_transport(transport)
+    fake_world(EchoAllgather(delay_s=3.0))
+    state, reds = _sum_state()
+    host_sync_state(state, reds, timeout=0.2, on_missing="quorum")
+    assert resilience.membership_epoch() == 1 and resilience.live_ranks() == (0,)
+
+    # the lost peer comes back: the next quorum-mode sync renegotiates the
+    # full membership and gathers over the full world again
+    transport.live = (0, 1)
+    fake_world(EchoAllgather())  # transport healed
+    out = host_sync_state(state, reds, timeout=0.2, on_missing="quorum")
+    assert resilience.membership_epoch() == 2
+    assert resilience.live_ranks() == (0, 1)
+    assert resilience.effective_world() == WORLD
+    np.testing.assert_allclose(np.asarray(out["x"]), WORLD * 1.0)
+
+
+def test_on_missing_quorum_all_live_is_invisible(fake_world):
+    from metrics_tpu.parallel import resilience
+
+    transport = _EchoTransport(live=(0, 1))
+    resilience.set_quorum_transport(transport)
+    ag = fake_world(EchoAllgather())
+    state, reds = _sum_state()
+    out = host_sync_state(state, reds, update_count=1, on_missing="quorum")
+    # all-live: identical collectives to on_missing="raise", no negotiation,
+    # no subset routing, membership untouched
+    np.testing.assert_allclose(np.asarray(out["x"]), WORLD * 1.0)
+    assert resilience.membership_epoch() == 0
+    assert transport.subset_calls == 0
+    assert ag.calls == 2  # header + one fused payload bucket, as ever
+
+
+def test_async_on_missing_local_degrades_at_resolve(fake_world):
+    # overlapped round: the peer dies mid-flight; the launch-time policy
+    # rides the round and degrades the resolve instead of raising
+    m = _distributed_metric(fake_world, EchoAllgather(delay_s=3.0))
+    m.sync_timeout = 0.2
+    m.sync_on_missing = "local"
+    m.update(jnp.asarray(1.0))
+    m.sync(blocking=False)
+    with pytest.warns(RuntimeWarning, match="LOCAL-ONLY"):
+        m.sync()
+    assert not m._is_synced and m._sync_degraded
+    np.testing.assert_allclose(np.asarray(m.x), 1.0)

@@ -1,0 +1,649 @@
+"""Elastic fleet resilience suite (ISSUE 16 tentpole).
+
+Three layers, mirroring the bucketed/grouped suites' standards:
+
+- **Unit**: the probation state machine (suspect -> cooldown -> probe ->
+  readmit, exponential backoff), membership epoch guards, the adaptive
+  controller's event-driven tuning, and the watchdog-timeout precedence
+  ladder (explicit > adaptive > env > default).
+- **Fleet integration** (:class:`tests.helpers.fake_world.FleetWorld`):
+  every rank runs the REAL quorum-mode sync concurrently against a
+  fault-profile world. All-live quorum must be **bit-identical** to
+  ``on_missing="raise"``; a dead rank shrinks the membership to the
+  survivor set within one epoch with ZERO manual
+  ``reset_channel_health()`` calls, and survivor values are bit-equal to a
+  survivors-only reference world; a transient partition heals itself
+  (shrink -> serve-degraded -> renegotiate -> readmit).
+- **Scale smoke**: a W=64 fleet with mid-run preemptions converges and
+  stays symmetric.
+"""
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.parallel.resilience as resilience
+from metrics_tpu.core.cat_buffer import CatBuffer
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.observability import diagnostics, journal
+from metrics_tpu.observability.registry import process_snapshot
+from metrics_tpu.parallel.bucketing import clear_sync_plan_cache
+from metrics_tpu.parallel.health import DEFAULT_SYNC_TIMEOUT_S, get_sync_timeout
+from metrics_tpu.parallel.sync import host_sync_state
+from metrics_tpu.utils.exceptions import SyncTimeoutError
+from tests.helpers.fake_world import FaultProfile, FleetWorld
+
+pytestmark = pytest.mark.filterwarnings("ignore::UserWarning")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_resilience():
+    saved_probation = dict(resilience._PROBATION)
+    resilience.reset_resilience()
+    clear_sync_plan_cache()
+    journal.clear()
+    yield
+    resilience.reset_resilience()
+    resilience._PROBATION.update(saved_probation)
+    clear_sync_plan_cache()
+    journal.disable()
+    journal.clear()
+    diagnostics.reset("quorum-flapping")
+
+
+@pytest.fixture
+def fleet(monkeypatch):
+    """Factory building installed FleetWorlds; sequential worlds per test
+    (a later ``make`` uninstalls the previous world first)."""
+    holder = {"world": None}
+
+    def make(world=4, profile=None, **kwargs):
+        if holder["world"] is not None:
+            holder["world"].uninstall()
+        clear_sync_plan_cache()
+        w = FleetWorld(world, profile, **kwargs)
+        w.install(monkeypatch)
+        holder["world"] = w
+        return w
+
+    yield make
+    if holder["world"] is not None:
+        holder["world"].uninstall()
+
+
+# ---------------------------------------------------------------------------
+# probation state machine
+# ---------------------------------------------------------------------------
+
+
+def test_probation_lifecycle_readmits_without_manual_reset(monkeypatch):
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(resilience, "_now", lambda: clock["t"])
+    resilience.configure_probation(base_cooldown_s=10.0, backoff=2.0)
+    before = process_snapshot()
+
+    assert resilience.channel_gate() == "open"
+    resilience.mark_channel_suspect()
+    assert resilience.channel_is_suspect()
+    assert resilience.channel_gate() == "refuse"
+
+    clock["t"] += 10.5  # cooldown elapsed -> exactly one probe admitted
+    assert resilience.channel_gate() == "probe"
+    resilience.channel_probe_succeeded()
+    assert not resilience.channel_is_suspect()
+    assert resilience.channel_gate() == "open"
+
+    after = process_snapshot()
+    assert after["channel_readmits"] == before["channel_readmits"] + 1
+    assert after["suspect_episode_s"] >= before["suspect_episode_s"] + 10.5
+    assert after["channel_resets"] == before["channel_resets"]  # no manual reset
+
+
+def test_probe_failure_doubles_cooldown_capped(monkeypatch):
+    clock = {"t": 0.0}
+    monkeypatch.setattr(resilience, "_now", lambda: clock["t"])
+    resilience.configure_probation(base_cooldown_s=10.0, max_cooldown_s=15.0, backoff=2.0)
+
+    resilience.mark_channel_suspect()
+    clock["t"] = 10.5
+    assert resilience.channel_gate() == "probe"
+    resilience.mark_channel_suspect()  # probe FAILED -> doubled (capped at 15)
+    assert resilience.channel_gate() == "refuse"
+    clock["t"] = 10.5 + 10.5  # base elapsed again, but cooldown is now 15
+    assert resilience.channel_gate() == "refuse"
+    clock["t"] = 10.5 + 15.5
+    assert resilience.channel_gate() == "probe"
+    resilience.channel_probe_succeeded()
+    assert resilience.channel_gate() == "open"
+
+
+def test_mark_suspect_while_suspect_is_idempotent(monkeypatch):
+    clock = {"t": 0.0}
+    monkeypatch.setattr(resilience, "_now", lambda: clock["t"])
+    resilience.configure_probation(base_cooldown_s=10.0)
+    resilience.mark_channel_suspect()
+    clock["t"] = 5.0
+    resilience.mark_channel_suspect()  # re-mark mid-cooldown: no restart
+    clock["t"] = 10.5
+    assert resilience.channel_gate() == "probe"
+
+
+# ---------------------------------------------------------------------------
+# membership epochs
+# ---------------------------------------------------------------------------
+
+
+def test_advance_membership_is_epoch_guarded(monkeypatch):
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    m0 = resilience.current_membership()
+    assert m0.epoch == 0 and not m0.degraded
+    assert resilience.effective_world() == 4
+
+    m1 = resilience.advance_membership([0, 1, 2], 1)
+    assert m1.epoch == 1 and m1.degraded
+    assert resilience.live_ranks() == (0, 1, 2)
+    assert resilience.effective_world() == 3
+
+    # stale/equal epoch proposals are no-ops (idempotent across racing paths)
+    stale = resilience.advance_membership([0, 1, 2, 3], 1)
+    assert stale.epoch == 1 and resilience.live_ranks() == (0, 1, 2)
+
+    m2 = resilience.advance_membership([0, 1, 2, 3], 2, reason="readmit")
+    assert m2.epoch == 2 and not m2.degraded
+    assert resilience.effective_world() == 4
+
+
+def test_quorum_flapping_warns_once():
+    diagnostics.reset("quorum-flapping")
+    resilience.note_sync_round()
+    resilience._note_shrink(None)  # first shrink: no warning
+    assert not diagnostics.seen("quorum-flapping")
+    resilience.note_sync_round()
+    resilience._note_shrink(None)  # second within the window: warn
+    assert diagnostics.seen("quorum-flapping")
+
+
+# ---------------------------------------------------------------------------
+# adaptive controller + timeout precedence
+# ---------------------------------------------------------------------------
+
+
+def test_get_sync_timeout_precedence(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_SYNC_TIMEOUT_S", "123")
+    assert get_sync_timeout(7.0) == 7.0  # explicit beats everything
+    assert get_sync_timeout() == 123.0  # env beats default
+    resilience._set_adaptive_timeout(42.0)
+    assert get_sync_timeout() == 42.0  # adaptive beats env
+    assert get_sync_timeout(7.0) == 7.0  # explicit still wins
+    resilience._set_adaptive_timeout(None)
+    monkeypatch.delenv("METRICS_TPU_SYNC_TIMEOUT_S")
+    assert get_sync_timeout() == DEFAULT_SYNC_TIMEOUT_S
+
+
+def test_controller_commits_ewma_timeout_with_hysteresis():
+    journal.enable()
+    ctrl = resilience.AdaptiveController(
+        floor_s=1.0, multiplier=4.0, alpha=0.5, hysteresis=0.25
+    ).start()
+    try:
+        journal.record("sync.resolve", label="m", gather_s=0.5)
+        assert resilience.adaptive_sync_timeout() == pytest.approx(2.0)
+        assert get_sync_timeout() == pytest.approx(2.0)
+        # unchanged observation: within hysteresis, no re-commit
+        journal.record("sync.resolve", label="m", gather_s=0.5)
+        assert len(journal.events(kinds=["controller.timeout"])) == 1
+        # a big jump re-commits: ewma = 0.5 + 0.5*(4-0.5) = 2.25 -> 9.0
+        journal.record("sync.resolve", label="m", gather_s=4.0)
+        assert resilience.adaptive_sync_timeout() == pytest.approx(9.0)
+        assert len(journal.events(kinds=["controller.timeout"])) == 2
+    finally:
+        ctrl.stop()
+
+
+def test_controller_backs_off_under_watchdog_pressure():
+    journal.enable()
+    ctrl = resilience.AdaptiveController(floor_s=1.0, multiplier=4.0).start()
+    try:
+        journal.record("sync.resolve", label="m", gather_s=0.5)
+        assert resilience.adaptive_sync_timeout() == pytest.approx(2.0)
+        journal.record("health.watchdog", label="m", timeout_s=2.0)
+        assert resilience.adaptive_sync_timeout() == pytest.approx(4.0)
+        labels = [e.label for e in journal.events(kinds=["controller.timeout"])]
+        assert labels[-1] == "watchdog_pressure"
+    finally:
+        ctrl.stop()
+
+
+def test_controller_membership_schedule_decisions_and_revert(monkeypatch):
+    journal.enable()
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    ctrl = resilience.AdaptiveController().start()
+    try:
+        resilience.advance_membership([0, 1, 2], 1)
+        decisions = resilience.last_schedule_decisions()
+        assert decisions["sync_cadence_multiplier"]["value"] == 2
+        assert decisions["sync_cadence_multiplier"]["epoch"] == 1
+        assert decisions["staleness_policy"]["value"] == "snapshot"
+
+        resilience.advance_membership([0, 1, 2, 3], 2, reason="readmit")
+        decisions = resilience.last_schedule_decisions()
+        assert decisions["sync_cadence_multiplier"]["value"] == 1
+        assert decisions["sync_cadence_multiplier"]["epoch"] == 2
+        assert len(journal.events(kinds=["controller.schedule"])) == 4
+    finally:
+        ctrl.stop()
+
+    ctrl.revert()
+    assert resilience.last_schedule_decisions() == {}
+    assert resilience.adaptive_sync_timeout() is None
+    assert len(journal.events(kinds=["controller.revert"])) == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: all-live quorum == full sync, bit for bit
+# ---------------------------------------------------------------------------
+
+
+def _mixed_state(rank: int):
+    """Mixed dtypes, reductions, uneven cat rows and a CatBuffer."""
+    buf = CatBuffer(8)
+    buf.append(jnp.arange(2 + rank, dtype=jnp.float32) + 10.0 * rank)
+    state = {
+        "sum_f32": jnp.asarray([[1.5, 2.5]]) * (rank + 1),
+        "sum_i32": jnp.asarray([2, 3], jnp.int32) + rank,
+        "mean_f32": jnp.asarray([0.25, 0.75]) + rank,
+        "max_f32": jnp.asarray(1.0 + 3 * rank),
+        "cat_f32": jnp.arange(3 + rank, dtype=jnp.float32) + 10.0 * rank,
+        "buf": buf,
+    }
+    reductions = {
+        "sum_f32": "sum", "sum_i32": "sum", "mean_f32": "mean",
+        "max_f32": "max", "cat_f32": "cat", "buf": "cat",
+    }
+    return state, reductions
+
+
+def _state_bytes(state):
+    out = {}
+    for name in sorted(state):
+        v = state[name]
+        if isinstance(v, CatBuffer):
+            out[name] = (
+                v.capacity,
+                int(np.asarray(v.count)),
+                np.asarray(v.buffer).tobytes(),
+            )
+        elif isinstance(v, list):
+            out[name] = tuple(np.asarray(x).tobytes() for x in v)
+        else:
+            arr = np.asarray(v)
+            out[name] = (arr.dtype.str, arr.shape, arr.tobytes())
+    return out
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_all_live_quorum_bit_identical_to_full_sync(fleet, fused):
+    def run(on_missing):
+        world = fleet(world=2)
+
+        def body(rank):
+            state, reds = _mixed_state(rank)
+            synced = host_sync_state(
+                state, reds, update_count=1, timeout=0,
+                fused=fused, on_missing=on_missing,
+            )
+            return _state_bytes(synced)
+
+        return world.run(body), world
+
+    quorum_out, quorum_world = run("quorum")
+    assert quorum_world.gather_rounds_degraded == 0  # all-live: no shrink
+    raise_out, _ = run("raise")
+    assert quorum_out[0] == quorum_out[1]  # SPMD symmetric
+    for rank in range(2):
+        assert quorum_out[rank] == raise_out[rank]
+
+
+def test_all_live_quorum_overlapped_bit_identical(fleet):
+    world = fleet(world=2)
+
+    class _Sum(Metric):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + jnp.sum(x)
+
+        def compute(self):
+            return self.total
+
+    def body(rank):
+        feed = jnp.asarray([1.0 + rank, 2.0 * (rank + 1)])
+        over = _Sum(sync_timeout=0, sync_on_missing="quorum")
+        block = _Sum(sync_timeout=0, sync_on_missing="quorum")
+        over.update(feed)
+        block.update(feed)
+        block.sync()
+        over.sync(blocking=False)  # launch quorum-mode background round
+        over.sync()  # resolve
+        bits = (
+            np.asarray(over._state["total"]).tobytes(),
+            np.asarray(block._state["total"]).tobytes(),
+        )
+        over.unsync()
+        block.unsync()
+        return bits
+
+    results = world.run(body)
+    for over_bits, block_bits in results:
+        assert over_bits == block_bits
+    assert results[0] == results[1]
+    assert world.gather_rounds_degraded == 0
+
+
+def test_probe_round_readmits_through_real_sync(fleet):
+    """A suspect channel refuses, then its cooldown admits one probe round
+    whose SUCCESS readmits the channel — zero manual resets."""
+    world = fleet(world=2)
+    resilience.configure_probation(base_cooldown_s=3600.0)
+    before = process_snapshot()
+
+    def refused(rank):
+        resilience.mark_channel_suspect()
+        with pytest.raises(SyncTimeoutError, match="refused"):
+            host_sync_state(
+                {"s": jnp.asarray(1.0 + rank)}, {"s": "sum"},
+                update_count=1, timeout=0, on_missing="quorum",
+            )
+        return True
+
+    assert world.run(refused) == [True, True]
+
+    world = fleet(world=2)
+    resilience.configure_probation(base_cooldown_s=0.0)  # probe immediately
+
+    def probed(rank):
+        resilience.mark_channel_suspect()
+        synced = host_sync_state(
+            {"s": jnp.asarray(1.0 + rank)}, {"s": "sum"},
+            update_count=1, timeout=0, on_missing="quorum",
+        )
+        assert not resilience.channel_is_suspect()  # probe success readmits
+        return float(np.asarray(synced["s"]))
+
+    assert world.run(probed) == [3.0, 3.0]
+    after = process_snapshot()
+    assert after["channel_readmits"] >= before["channel_readmits"] + 2
+    assert after["channel_resets"] == before["channel_resets"]
+
+
+# ---------------------------------------------------------------------------
+# fleet integration: dead rank -> quorum shrink, survivors bit-equal
+# ---------------------------------------------------------------------------
+
+_STEPS_DEAD = 3
+
+
+def _round_state(rank: int, step: int):
+    state = {
+        "s": jnp.asarray(float(10 * rank + step)),
+        "c": jnp.arange(1 + rank % 2, dtype=jnp.float32) + rank + step,
+    }
+    return state, {"s": "sum", "c": "cat"}
+
+
+def _drive_quorum(world, steps, state_fn=_round_state):
+    def body(rank):
+        outs = []
+        for step in range(steps):
+            world.begin_round(rank, step)
+            state, reds = state_fn(rank, step)
+            synced = host_sync_state(
+                state, reds, update_count=1, timeout=0,
+                on_missing="quorum", metric_name="fleet",
+            )
+            outs.append(_state_bytes(synced))
+        return outs, resilience.membership_epoch(), resilience.live_ranks()
+
+    return world.run(body)
+
+
+def test_dead_rank_shrinks_within_one_epoch_survivors_bit_equal(fleet):
+    before = process_snapshot()
+    world = fleet(world=4, profile=FaultProfile(preempt_at={3: 1}))
+    results = _drive_quorum(world, _STEPS_DEAD)
+    assert world.preempted == {3}
+    assert results[3] is None  # the preempted rank returns nothing
+
+    for rank in (0, 1, 2):
+        outs, epoch, live = results[rank]
+        # converged in exactly ONE membership transition, no manual resets
+        assert epoch == 1
+        assert live == (0, 1, 2)
+
+    # survivors-only reference world: ranks 0..2 with identical per-rank
+    # states must produce bit-equal values for the post-death rounds
+    ref_world = fleet(world=3)
+    ref = _drive_quorum(ref_world, _STEPS_DEAD)
+    for rank in (0, 1, 2):
+        outs = results[rank][0]
+        ref_outs = ref[rank][0]
+        for step in (1, 2):  # post-death rounds gather over survivors
+            assert outs[step] == ref_outs[step], (rank, step)
+    # survivors agree with each other on every round
+    assert results[0][0] == results[1][0] == results[2][0]
+    after = process_snapshot()
+    assert after["quorum_shrinks"] > before["quorum_shrinks"]
+    assert after["channel_resets"] == before["channel_resets"]
+
+
+def test_transient_drop_degrades_then_readmits(fleet):
+    """Rank 2 is partitioned for rounds 1-2: survivors shrink and keep
+    syncing, the partitioned rank serves quorum-of-1 local values, and on
+    recovery EVERY rank renegotiates the full membership within one round."""
+    before = process_snapshot()
+    world = fleet(world=4, profile=FaultProfile(drop_rounds={2: (1, 2)}))
+    steps = 5
+
+    def body(rank):
+        track = []
+        for step in range(steps):
+            world.begin_round(rank, step)
+            state = {"s": jnp.asarray(float(10 * rank + step))}
+            synced = host_sync_state(
+                state, {"s": "sum"}, update_count=1, timeout=0,
+                on_missing="quorum", metric_name="fleet",
+            )
+            track.append(
+                (
+                    float(np.asarray(synced["s"])),
+                    resilience.membership_epoch(),
+                    resilience.live_ranks(),
+                )
+            )
+        return track
+
+    results = world.run(body)
+    full = tuple(range(4))
+    survivors = (0, 1, 3)
+    for rank in range(4):
+        values = results[rank]
+        # round 0: everyone, epoch 0
+        assert values[0] == (60.0, 0, full)
+        # rounds 3-4: healed — everyone readmitted at epoch 2 within ONE
+        # round of the window closing
+        assert values[3] == (60.0 + 4 * 3, 2, full)
+        assert values[4] == (60.0 + 4 * 4, 2, full)
+    for rank in survivors:
+        # rounds 1-2: survivor-set sums at epoch 1
+        assert results[rank][1] == (40.0 + 3 * 1, 1, survivors)
+        assert results[rank][2] == (40.0 + 3 * 2, 1, survivors)
+    # the partitioned rank served its own local value as a quorum of one
+    assert results[2][1] == (20.0 + 1, 1, (2,))
+    assert results[2][2] == (20.0 + 2, 1, (2,))
+
+    assert world.gather_rounds_degraded > 0
+    after = process_snapshot()
+    assert after["quorum_shrinks"] > before["quorum_shrinks"]
+    assert after["quorum_readmits"] > before["quorum_readmits"]
+    assert after["channel_resets"] == before["channel_resets"]
+
+
+def test_hazard_preemption_is_deterministic():
+    profile = FaultProfile(preempt_hazard=0.5, seed=7)
+    expected = {
+        r for r in range(8)
+        if zlib.crc32(f"7:{r}:0".encode()) / 2**32 < 0.5
+    }
+    world = FleetWorld(8, profile)
+
+    def body(rank):
+        world.begin_round(rank, 0)
+        return True
+
+    world.run(body)
+    assert world.preempted == expected
+
+
+# ---------------------------------------------------------------------------
+# scale smoke: W=64 with mid-run preemptions
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_w64_smoke(fleet):
+    W = 64
+    dead = {5: 2, 17: 2}
+    world = fleet(
+        world=W, profile=FaultProfile(preempt_at=dead, jitter_s=0.0005)
+    )
+    steps = 4
+    results = _drive_quorum(
+        world, steps, state_fn=lambda r, s: ({"s": jnp.asarray(float(r + s))}, {"s": "sum"})
+    )
+    assert world.preempted == set(dead)
+    survivors = [r for r in range(W) if r not in dead]
+    expected_final = float(sum(r + (steps - 1) for r in survivors))
+    for rank in survivors:
+        outs, epoch, live = results[rank]
+        assert epoch == 1
+        assert live == tuple(survivors)
+        # final round: every survivor computed the identical survivor sum
+        assert outs[-1] == results[survivors[0]][0][-1]
+        dtype, shape, raw = outs[-1]["s"]
+        assert np.frombuffer(raw, dtype=dtype).reshape(shape) == pytest.approx(
+            expected_final
+        )
+    assert world.gather_rounds_degraded > 0
+
+
+# ---------------------------------------------------------------------------
+# quorum under the async overlapped path + symmetric controller decisions
+# ---------------------------------------------------------------------------
+
+
+def test_async_quorum_shrinks_partitioned_rank_at_resolve(fleet):
+    """A rank is partitioned away while quorum-mode OVERLAPPED rounds run:
+    the background round's gather fails on its lane, the quorum retry
+    renegotiates the survivor set on the background thread, and the resolve
+    serves survivor-aggregated values — no manual resets, channel healthy.
+
+    Each round is resolved before the next ``begin_round`` so every lane
+    judges reachability at its own rank's settled step — the death boundary
+    is deterministic. (A wall-time mid-flight death instead makes survivors
+    legally disagree on whether the dying rank's last round completed; the
+    sync-epoch header column turns that into a symmetric typed raise, the
+    safe-but-nondeterministic outcome this test is not about.)"""
+    before = process_snapshot()
+    world = fleet(world=3, profile=FaultProfile(drop_rounds={2: (1, 10)}))
+
+    class _Sum(Metric):
+        def __init__(self, **kwargs):
+            super().__init__(**kwargs)
+            self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.total = self.total + jnp.sum(x)
+
+        def compute(self):
+            return self.total
+
+    def body(rank):
+        m = _Sum(sync_timeout=0, sync_on_missing="quorum")
+        m.distributed_available_fn = lambda: True
+        # round 0: everyone reachable — overlapped round gathers full world
+        world.begin_round(rank, 0)
+        m.update(jnp.asarray([1.0 + rank]))
+        m.sync(blocking=False)
+        m.sync()  # resolve
+        v0 = float(np.asarray(m._state["total"]))
+        m.unsync()
+        # round 1: rank 2 partitioned — the background header gather fails,
+        # the lane-side quorum retry shrinks to the survivors
+        world.begin_round(rank, 1)
+        m.update(jnp.asarray([10.0 + rank]))
+        m.sync(blocking=False)
+        m.sync()  # resolve the degraded round
+        v1 = float(np.asarray(m._state["total"]))
+        assert not resilience.channel_is_suspect()
+        return v0, v1, resilience.membership_epoch(), resilience.live_ranks()
+
+    results = world.run(body)
+    for rank in (0, 1):
+        v0, v1, epoch, live = results[rank]
+        assert v0 == 6.0  # round 0: full world, 1+2+3
+        assert v1 == 24.0  # round 1: survivors only, (1+10) + (2+11)
+        assert epoch == 1
+        assert live == (0, 1)
+    # the partitioned rank degrades to a quorum of one on its own lane
+    v0, v1, epoch, live = results[2]
+    assert (v0, v1) == (6.0, 15.0)  # local: 3 + 12
+    assert (epoch, live) == (1, (2,))
+    assert world.gather_rounds_degraded > 0
+    after = process_snapshot()
+    assert after["quorum_shrinks"] > before["quorum_shrinks"]
+    assert after["channel_resets"] == before["channel_resets"]
+
+
+def test_controller_decisions_symmetric_across_event_streams():
+    """Sustained watchdog pressure: controller decisions derive only from
+    collective-round facts every rank observes identically (the contract
+    metricslint's asymmetric-schedule-decision rule enforces statically),
+    so per-rank controllers fed the same event stream commit the IDENTICAL
+    journaled decision sequence."""
+    journal.enable()
+
+    def drive():
+        """One rank's view: same gather timings, same watchdog fire."""
+        ctrl = resilience.AdaptiveController(
+            floor_s=1.0, multiplier=4.0, alpha=0.5, hysteresis=0.25
+        ).start()
+        try:
+            for gather_s in (0.5, 0.5, 4.0):
+                journal.record("sync.resolve", label="m", gather_s=gather_s)
+            journal.record(
+                "health.watchdog", label="m",
+                timeout_s=resilience.adaptive_sync_timeout(),
+            )
+            trail = [
+                (e.kind, e.label, e.fields.get("timeout_s"))
+                for e in journal.events(kinds=["controller.timeout"])
+            ]
+            return (
+                resilience.adaptive_sync_timeout(),
+                resilience.last_schedule_decisions(),
+                trail,
+            )
+        finally:
+            ctrl.stop()
+            ctrl.revert()
+            journal.clear()
+
+    rank0 = drive()
+    rank1 = drive()
+    assert rank0 == rank1
+    # pressure actually escalated: ewma commit 2.0 -> 9.0, then doubled
+    assert rank0[0] == pytest.approx(18.0)
+    assert [t[1] for t in rank0[2]] == ["ewma", "ewma", "watchdog_pressure"]
