@@ -3,7 +3,7 @@
 Default (driver contract): runs BASELINE config 1 and prints ONE JSON line
 ``{"metric", "value", "unit", "vs_baseline"}``.
 
-``python bench.py --all`` additionally runs configs 2-13 (one JSON line
+``python bench.py --all`` additionally runs configs 2-15 (one JSON line
 each; ``--config N`` runs selected ones — a comma-separated list like
 ``--config 9,11`` runs several in one process sharing compile-cache warmth;
 see BASELINE.md for the config table and BENCH.md for recorded numbers;
@@ -15,7 +15,9 @@ compiled vs eager step time, dispatch counts and bit-equality, config 12
 the async overlapped sync, config 13 the telemetry recorder's hot-path
 overhead + trace-export smoke, config 14 the fleet-resilience simulation —
 quorum readmission latency after a transient partition plus the
-dead-rank degradation curve).
+dead-rank degradation curve, config 15 the whole-step fused program —
+update + in-jit fused sync + compute as ONE cached XLA dispatch vs the
+compiled-update + separate-host-sync composition at simulated W=8).
 
 Timing methodology (see BENCH.md): hot paths are timed **on-chip** by
 scanning K steps inside ONE jitted program (``lax.scan``) and dividing — a
@@ -2247,7 +2249,246 @@ def bench_config14() -> None:
     )
 
 
+def bench_config15() -> None:
+    """Config 15: whole-step fused program — ``update + in-jit sync(fused) +
+    compute`` as ONE cached XLA program (``core/plan.py``) vs the PR-5
+    compiled update + separate blocking host sync, over the config-11
+    stat-score workload at simulated W=8.
+
+    The ISSUE-17 acceptance measurement. The fused side runs the 4-member
+    Precision/Recall/F1/Specificity collection inside a user-style
+    ``jax.jit(shard_map(step))`` over 8 devices (CPU runs force
+    ``--xla_force_host_platform_device_count=8``; ``main()`` injects the
+    flag before backend init when config 15 is requested): per step the
+    sharded batch updates, the bucketed fused psum syncs, and every member
+    computes — one donated dispatch, values served every step. The legacy
+    side is the config-11 compiled stateful update per rank over the
+    LockstepWorld W=8 threads harness plus the separate blocking host sync
+    (``sync(); compute(); unsync()``) each step — the pre-plan way to get
+    the same per-step synced values. Asserts (CI gates contract):
+
+    - exactly ONE XLA program serves the whole fused step: the jitted
+      step's executable cache holds 1 entry after the loop (no retrace
+      churn) and the plan binding holds exactly 1 cached inline program;
+    - the fused values are **bit-identical** to the legacy host-synced
+      values at every compared step (integer stat-score states make this
+      exact, not approximate);
+    - fused step time ≤ the update-ONLY sharded program × 1.5 at the SAME
+      W=8 (the config-11 path's work, re-measured in-process over the same
+      mesh): the in-program sync + all 4 computes must ride along for a
+      bounded fraction of the step, not double it (on real TPU ICI the
+      collective overlaps with compute; forced CPU devices pay memcpy
+      collectives, hence the margin — the W=1 config-11 number rides the
+      diagnostic line for reference);
+    - fused step time strictly below the legacy compiled-update +
+      host-sync-per-step loop.
+
+    Emits ``fused_whole_step_us`` with ``vs_baseline`` = legacy/fused.
+    """
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    import metrics_tpu.parallel.sync as sync_mod
+    from metrics_tpu import F1, Precision, Recall, Specificity
+    from metrics_tpu.core import plan as plan_mod
+    from metrics_tpu.core.collections import MetricCollection
+    from metrics_tpu.parallel.bucketing import clear_sync_plan_cache
+    from tests.helpers.fake_world import LockstepWorld
+
+    W, B, STEPS, EQ_STEPS = 8, 256, 30, 8
+    devs = jax.devices()
+    if len(devs) < W:
+        raise RuntimeError(
+            f"config 15 needs {W} devices for the in-jit fused sync; got "
+            f"{len(devs)} (CPU runs need XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={W}, injected by main() "
+            "when --config includes 15)"
+        )
+    rng = np.random.RandomState(15)
+    preds = [jnp.asarray(rng.rand(B, NUM_CLASSES).astype(np.float32)) for _ in range(EQ_STEPS)]
+    target = [jnp.asarray(rng.randint(0, NUM_CLASSES, (B,))) for _ in range(EQ_STEPS)]
+
+    def make_stats() -> MetricCollection:
+        return MetricCollection(
+            {
+                "prec": Precision(num_classes=NUM_CLASSES, average="macro"),
+                "rec": Recall(num_classes=NUM_CLASSES, average="macro"),
+                "f1": F1(num_classes=NUM_CLASSES, average="macro"),
+                "spec": Specificity(num_classes=NUM_CLASSES, average="macro"),
+            }
+        )
+
+    def shard(x):
+        return x.reshape((W, B // W) + x.shape[1:])
+
+    # ---- fused whole-step: ONE donated program inside the user's jit ----
+    plan_mod.clear_plans()
+    mesh = Mesh(np.array(devs[:W]), ("w",))
+    col = make_stats()
+
+    @partial(jax.jit, donate_argnums=(0,))
+    @partial(shard_map, mesh=mesh, in_specs=(P("w"), P("w"), P("w")), out_specs=(P("w"), P()))
+    def fused_step(state, p, t):
+        st = jax.tree_util.tree_map(lambda x: x[0], state)
+        ns, vals = col.compiled_step(st, p[0], t[0], axis_name="w")
+        return jax.tree_util.tree_map(lambda x: x[None], ns), vals
+
+    carry_sharding = jax.sharding.NamedSharding(mesh, P("w"))
+
+    def fresh_carry():
+        # pin the initial carry to the same sharding the step outputs, or the
+        # second call would see a different input layout and retrace
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(jnp.stack([x] * W), carry_sharding),
+            col.init_state(),
+        )
+
+    state = fresh_carry()
+    fused_values = []
+    for i in range(EQ_STEPS):
+        state, vals = fused_step(state, shard(preds[i]), shard(target[i]))
+        fused_values.append({k: np.asarray(v).copy() for k, v in vals.items()})
+
+    state = fresh_carry()
+    state, _ = fused_step(state, shard(preds[0]), shard(target[0]))  # warm
+    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state, vals = fused_step(state, shard(preds[0]), shard(target[0]))
+    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    fused_us = (time.perf_counter() - t0) / STEPS * 1e6
+
+    cache_size = getattr(fused_step, "_cache_size", lambda: 1)()
+    assert cache_size == 1, f"fused step retraced: executable cache {cache_size} != 1"
+    inline_programs = len(plan_mod.peek_binding(col).programs)
+    assert inline_programs == 1, f"plan binding holds {inline_programs} programs != 1"
+
+    # ---- update-ONLY sharded program: the same work minus sync+compute ----
+    @partial(jax.jit, donate_argnums=(0,))
+    @partial(shard_map, mesh=mesh, in_specs=(P("w"), P("w"), P("w")), out_specs=P("w"))
+    def update_only_step(state, p, t):
+        st = jax.tree_util.tree_map(lambda x: x[0], state)
+        ns = col.pure_update(st, p[0], t[0])
+        return jax.tree_util.tree_map(lambda x: x[None], ns)
+
+    state = fresh_carry()
+    state = update_only_step(state, shard(preds[0]), shard(target[0]))  # warm
+    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        state = update_only_step(state, shard(preds[0]), shard(target[0]))
+    jax.block_until_ready(jax.tree_util.tree_leaves(state)[0])
+    update_sharded_us = (time.perf_counter() - t0) / STEPS * 1e6
+
+    # ---- legacy: PR-5 compiled update + separate blocking host sync ----
+    def run_legacy():
+        world = LockstepWorld(W)
+        saved = (jax.process_count, sync_mod._raw_process_allgather)
+        clear_sync_plan_cache()
+        values = [[] for _ in range(W)]
+        try:
+            jax.process_count = lambda: W
+            sync_mod._raw_process_allgather = world.allgather
+
+            def body(rank):
+                mc = make_stats()
+                for m in mc.values():
+                    m.compiled_update = True  # engage immediately (skip warm-up)
+                    m.sync_timeout = 0  # inline watchdog: thread-local survives
+                    m.distributed_available_fn = lambda: True
+                for i in range(EQ_STEPS):
+                    mc.update(shard(preds[i])[rank], shard(target[i])[rank])
+                    mc.sync(timeout=0)
+                    values[rank].append(
+                        {k: np.asarray(v).copy() for k, v in mc.compute().items()}
+                    )
+                    mc.unsync()
+                # timed window: same steady-state step, batch 0 repeated
+                t0 = time.perf_counter()
+                for _ in range(STEPS):
+                    mc.update(shard(preds[0])[rank], shard(target[0])[rank])
+                    mc.sync(timeout=0)
+                    mc.compute()
+                    mc.unsync()
+                return time.perf_counter() - t0
+
+            elapsed = world.run(body, timeout=600.0)
+        finally:
+            jax.process_count, sync_mod._raw_process_allgather = saved
+            world.shutdown_executors()
+            clear_sync_plan_cache()
+        return max(elapsed) / STEPS * 1e6, values
+
+    legacy_us, legacy_values = run_legacy()
+
+    # ---- bit-identity: fused values == legacy host-synced values ----
+    for i in range(EQ_STEPS):
+        ref = legacy_values[0][i]
+        assert sorted(fused_values[i]) == sorted(ref)
+        for k in ref:
+            a, b = fused_values[i][k], ref[k]
+            assert a.dtype == b.dtype and a.tobytes() == b.tobytes(), (
+                f"step {i} value {k} diverged fused vs legacy host sync"
+            )
+
+    # ---- step-time gates ----
+    mc = make_stats()
+    for m in mc.values():
+        m.compiled_update = True
+    mc.update(preds[0], target[0])  # warm: group plan + trace
+    jax.block_until_ready(mc["prec"]._state["tp"])
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        mc.update(preds[0], target[0])
+    jax.block_until_ready(mc["prec"]._state["tp"])
+    update_w1_us = (time.perf_counter() - t0) / STEPS * 1e6  # config-11 reference
+
+    assert fused_us <= update_sharded_us * 1.5, (
+        f"fused whole step {fused_us:.1f}us/step exceeds the update-only "
+        f"sharded program {update_sharded_us:.1f}us/step x1.5 — the in-program "
+        "sync+compute increment is out of bounds"
+    )
+    assert fused_us < legacy_us, (
+        f"fused whole step {fused_us:.1f}us/step not below legacy compiled "
+        f"update + host sync {legacy_us:.1f}us/step"
+    )
+
+    _diag(
+        config=15,
+        world=W,
+        batch=B,
+        fused_step_us=round(fused_us, 2),
+        update_only_sharded_us=round(update_sharded_us, 2),
+        compiled_update_w1_us=round(update_w1_us, 2),
+        legacy_update_plus_host_sync_us=round(legacy_us, 2),
+        dispatches_per_step=1,
+        executable_cache=cache_size,
+        equality=f"bit-identical over {EQ_STEPS} synced steps (W={W})",
+    )
+    _emit(
+        "fused_whole_step_us",
+        round(fused_us, 2),
+        "us/step",
+        round(legacy_us / fused_us, 3),
+    )
+
+
 def main() -> None:
+    if "--config" in sys.argv:
+        # config 15's in-jit fused sync needs 8 devices; on CPU hosts that
+        # means forcing virtual devices BEFORE the backend initializes
+        i = sys.argv.index("--config") + 1
+        raw = sys.argv[i] if i < len(sys.argv) else ""
+        if "15" in [k.strip() for k in raw.split(",")]:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
     try:
         platform = _ensure_backend()
         _enable_persistent_compile_cache()
@@ -2272,7 +2513,7 @@ def main() -> None:
     except Exception:
         vs = None
     _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
-    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10, "11": bench_config11, "12": bench_config12, "13": bench_config13, "14": bench_config14}
+    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10, "11": bench_config11, "12": bench_config12, "13": bench_config13, "14": bench_config14, "15": bench_config15}
     if "--config" in sys.argv:
         # comma-separated list (--config 9,11): related configs run in one
         # process and share compile-cache warmth (CI gates contract)

@@ -48,6 +48,7 @@ RUNTIME_EXEMPT_ATTRS = frozenset(
         "_pure_mode",
         "_donation_ready",
         "_compiled",
+        "_plan_binding",
         "_cache",
         "_update_kwarg_names",
         "_ckpt_suppress",

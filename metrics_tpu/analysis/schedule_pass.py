@@ -117,14 +117,17 @@ _LOCAL_CALLS = frozenset(
     {"channel_is_suspect", "channel_gate", "process_index", "build_health_word"}
 )
 
-#: the adaptive controller's one collective-affecting commit point
-#: (``parallel/resilience.py``): every sync-cadence / staleness-policy /
-#: timeout decision that can change WHICH collectives ranks emit flows
-#: through ``commit_schedule_decision``. The ``asymmetric-schedule-decision``
-#: rule checks its inputs are symmetric — a decision derived from rank- or
-#: data-tainted values would legally desynchronize the fleet one config knob
+#: the collective-affecting commit points: every sync-cadence /
+#: staleness-policy / timeout decision that can change WHICH collectives
+#: ranks emit flows through ``commit_schedule_decision``
+#: (``parallel/resilience.py``), and every execution-plan invalidation —
+#: which retraces fused programs and re-keys the bucketed sync layout —
+#: flows through ``plan_invalidate`` (``core/plan.py``). The
+#: ``asymmetric-schedule-decision`` rule checks their inputs are symmetric —
+#: a decision derived from rank- or data-tainted values would legally
+#: desynchronize the fleet one config knob (or one rank's plan generation)
 #: at a time.
-SCHEDULE_DECISION_CALLS = frozenset({"commit_schedule_decision"})
+SCHEDULE_DECISION_CALLS = frozenset({"commit_schedule_decision", "plan_invalidate"})
 
 #: calls whose results are symmetric no matter the arguments (collective
 #: results are world-replicated; verify_health_words raises symmetrically

@@ -37,6 +37,7 @@ from metrics_tpu.core.compiled import (
     rebuild_call,
     split_call,
 )
+from metrics_tpu.core import plan as plan_mod
 from metrics_tpu.core.metric import (
     _ComputeGroup,
     _ON_ERROR_MODES,
@@ -324,8 +325,8 @@ class MetricCollection(dict):
         else:
             raise ValueError("Unknown input to MetricCollection.")
         # membership changed: re-plan compute groups at the next dispatch
-        self._groups_planned = False
-        self._groups_stale = True
+        # (the partition is plan state — one invalidation path, core/plan.py)
+        plan_mod.plan_invalidate(self, "membership-changed", schema_changed=True)
 
     def _set_name(self, base: str) -> str:
         name = base if self.prefix is None else self.prefix + base
@@ -612,7 +613,7 @@ class MetricCollection(dict):
                 "group.detach", label="MetricCollection",
                 members=len(members), reason="dispatch-failure",
             )
-        self._groups_stale = True
+        plan_mod.plan_invalidate(self, "group-dispatch-failure", groups_stale=True)
 
     # ---------------- forward / update / compute ----------------
 
@@ -832,7 +833,7 @@ class MetricCollection(dict):
             ns = new_states[k]
             for name in st:
                 st[name] = ns[name]
-            object.__setattr__(m, "_donation_ready", True)
+            m._mark_donation_ready()
             try:
                 _raise_on_catbuffer_overflow(st, type(m).__name__)
             except MetricsTPUUserError:
@@ -942,7 +943,7 @@ class MetricCollection(dict):
         st = source._state
         for name in st:
             st[name] = new_state[name]
-        object.__setattr__(source, "_donation_ready", True)
+        source._mark_donation_ready()
         try:
             _raise_on_catbuffer_overflow(st, type(source).__name__)
         except MetricsTPUUserError:
@@ -1125,7 +1126,7 @@ class MetricCollection(dict):
                 self._relink_group(g)
         # every member is back on its defaults: re-plan at the next dispatch
         # so members that had copy-on-write detached can rejoin their group
-        self._groups_stale = True
+        plan_mod.plan_invalidate(self, "reset", groups_stale=True)
 
     def __getstate__(self) -> Dict[str, Any]:
         # consulted by BOTH pickle and copy.deepcopy (via __reduce_ex__):
@@ -1133,7 +1134,9 @@ class MetricCollection(dict):
         # serialized or copied — drain it symmetrically first (fold-back
         # preserves every member's accumulation)
         self._cancel_overlap()
-        return self.__dict__
+        # the plan binding's cached programs close over THIS instance and
+        # don't serialize — the copy re-creates a fresh binding lazily
+        return {k: v for k, v in self.__dict__.items() if k != "_plan_binding"}
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
@@ -1195,8 +1198,7 @@ class MetricCollection(dict):
                 )
         for k, m in super().items():
             m.load_state_dict(state_dict, prefix=f"{k}.")
-        self._groups_planned = False
-        self._groups_stale = True
+        plan_mod.plan_invalidate(self, "load-state-dict", schema_changed=True)
 
     def checkpointer(
         self,
@@ -1536,6 +1538,11 @@ class MetricCollection(dict):
 
         owners = self._sync_state_owners()
         combined, reductions = self._combined_payload(owners, lambda m: m._state)
+        # attribute the combined schema's plan build/hit to the collection's
+        # registry (host_sync_state consults the store with no owner in scope)
+        from metrics_tpu.core.plan import plan_for
+
+        plan_for(combined, reductions, owner=self)
         synced = host_sync_state(
             combined,
             reductions,
@@ -1559,7 +1566,7 @@ class MetricCollection(dict):
                 # the synced leaves alias the owner's (and the caches hold the
                 # pre-sync arrays): donation must copy first — mirrors what
                 # Metric._restore guarantees for the owner
-                object.__setattr__(p, "_donation_ready", False)
+                p._mark_state_mutated("fused-sync")
                 for name in m._state:
                     p._state[name] = m._state[name]
                 p._is_synced = True
@@ -1603,7 +1610,12 @@ class MetricCollection(dict):
         the in-flight bookkeeping."""
         combined, reductions = self._combined_payload(owners, state_of)
         counts = {key: getattr(m, "_update_count", 0) for key, m, _peers in owners}
-        self._sync_epoch = self.__dict__.get("_sync_epoch", 0) + 1
+        # warm + attribute the combined schema's plan on the launching
+        # thread (the background gather consults the store ownerless)
+        plan_mod.plan_for(combined, reductions, owner=self)
+        # epoch bookkeeping lives with the plan binding (mirrored onto
+        # ``_sync_epoch``, the header column every rank cross-checks)
+        plan_mod.next_sync_epoch(self)
         round_ = launch_round(
             combined,
             reductions,
@@ -1649,7 +1661,7 @@ class MetricCollection(dict):
                 for x in [m] + peers:
                     x._cache = {k: _copy_state_value(v) for k, v in fresh.items()}
                     x._sync_degraded = False
-                    object.__setattr__(x, "_donation_ready", False)
+                    x._mark_state_mutated("serve-local")
                     for name in x._state:
                         x._state[name] = snapshots[key][name]
                     x._is_synced = True
@@ -1775,7 +1787,7 @@ class MetricCollection(dict):
             for p in grouped:
                 p._cache = {k: _copy_state_value(v) for k, v in local.items()}
                 p._sync_degraded = False
-                object.__setattr__(p, "_donation_ready", False)
+                p._mark_state_mutated("overlap-resolve")
                 for name in x._state:
                     p._state[name] = x._state[name]
                 p._is_synced = True
@@ -1992,6 +2004,30 @@ class MetricCollection(dict):
         values = self.pure_compute(value_state)
         new_state = self.merge_states(state, batch)
         return new_state, values
+
+    def compiled_step(
+        self,
+        state: Dict[str, Any],
+        *args: Any,
+        axis_name: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """The whole-step fused program for the WHOLE collection: every
+        member's ``update``, ONE fused in-jit sync round, every member's
+        ``compute`` — cached as a single XLA program (bench config 15).
+
+        Returns ``(new_state, values)``: ``values`` holds what a blocking
+        ``sync(); compute()`` of the accumulated state would serve per
+        member key, with the collective issued inside the program so XLA
+        schedules it against the metric computes — a periodic per-step
+        ``compute()`` adds zero extra dispatches. Inside a jit/pjit/
+        ``shard_map`` step it inlines into the user's one program; eagerly
+        it dispatches a cached donated program (thread ``new_state``
+        forward like a scan carry). Managed by ``core/plan.py``
+        (``METRICS_TPU_UNIFIED_PLAN=0`` restores the legacy separate-phase
+        composition).
+        """
+        return plan_mod.compiled_step(self, state, args, kwargs, axis_name=axis_name)
 
     def __repr__(self) -> str:
         repr_str = self.__class__.__name__ + "(\n"

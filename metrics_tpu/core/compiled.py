@@ -224,6 +224,7 @@ _PROBE_EXEMPT = frozenset(
         "_pure_mode",
         "_donation_ready",
         "_compiled",
+        "_plan_binding",
         "_cache",
         "_update_kwarg_names",
         "_ckpt_suppress",
@@ -370,7 +371,13 @@ class CompiledDispatcher:
     ``MetricCollection``) that ever considers the compiled path. It owns
 
     - the jitted-program cache, keyed by ``(kind, call skeleton)`` — jax's
-      own jit cache handles per-shape retracing *within* each key;
+      own jit cache handles per-shape retracing *within* each key. The
+      storage lives in the owner's :class:`~metrics_tpu.core.plan.
+      PlanBinding` (``Metric._compiled_dispatcher`` passes it), so the
+      dispatcher is a *view* into the unified execution plan rather than an
+      independent schema-keyed cache — the whole-step fused programs
+      (``plan.compiled_step``) share the same namespace under disjoint
+      keys;
     - the counters ``traces`` / ``dispatches`` / ``steps_seen`` surfaced by
       ``compile_stats()`` (``cache_hits = dispatches - traces``);
     - the permanent per-kind ``fallback`` map with its one-time diagnostic
@@ -387,8 +394,7 @@ class CompiledDispatcher:
         "label",
         "uid",
         "_stats",
-        "_programs",
-        "_probed",
+        "_binding",
         "_churn_warned",
     )
 
@@ -398,7 +404,12 @@ class CompiledDispatcher:
     #: instance's first warning)
     _uid_counter = itertools.count()
 
-    def __init__(self, label: str, stats: Optional[Dict[str, Any]] = None) -> None:
+    def __init__(
+        self,
+        label: str,
+        stats: Optional[Dict[str, Any]] = None,
+        binding: Optional[Any] = None,
+    ) -> None:
         self.label = label
         self.uid = next(CompiledDispatcher._uid_counter)
         self._churn_warned = False
@@ -412,8 +423,22 @@ class CompiledDispatcher:
         self._stats.setdefault("steps_seen", 0)
         if not isinstance(self._stats.get("fallback"), dict):
             self._stats["fallback"] = {}
-        self._programs: Dict[Any, Any] = {}
-        self._probed: set = set()
+        # program/probe storage: the owner's PlanBinding when bound, else a
+        # private binding of the same shape — either way the dispatcher is a
+        # view, never an independent cache
+        if binding is None:
+            from metrics_tpu.core.plan import PlanBinding
+
+            binding = PlanBinding(label)
+        self._binding = binding
+
+    @property
+    def _programs(self) -> Dict[Any, Any]:
+        return self._binding.programs
+
+    @property
+    def _probed(self) -> set:
+        return self._binding.probed
 
     # counter shims: every counting site reads/writes the registry dict
     @property

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import Array
 
+from metrics_tpu.core import plan as plan_mod
 from metrics_tpu.core.cat_buffer import CatBuffer
 from metrics_tpu.core.compiled import (
     CompiledDispatcher,
@@ -301,6 +302,9 @@ def _reset_compiled_for_copy(m: "Metric") -> None:
     lazily re-created dispatcher binds to clean counters describing the new
     instance alone."""
     m.__dict__.pop("_compiled", None)
+    # the plan binding holds the programs the dispatcher viewed (plus the
+    # fused-step cache) — same closes-over-the-original argument
+    m.__dict__.pop("_plan_binding", None)
     reg = m.__dict__.get("_telemetry")
     if reg is not None:
         dom = reg.domain("compile")
@@ -449,6 +453,23 @@ class Metric:
     cache hits and the fallback reason. Ragged tail batches simply retrace
     once per new shape (cached across epochs); sustained shape churn emits
     a one-time diagnostic. See ``docs/performance.md``.
+
+    **Unified execution plan.** Every schema-keyed planning decision —
+    compute-group partition, bucketed sync layout, compiled programs,
+    async round epochs — is owned by ONE cached
+    :class:`~metrics_tpu.core.plan.ExecutionPlan` keyed on the state
+    schema (``core/plan.py``; its ``schema_crc`` equals the health word's
+    schema column, so plan telemetry correlates with failed health checks
+    across ranks). All invalidation routes funnel through
+    ``plan_invalidate(owner, reason)``, which bumps the owner's binding
+    generation and accounts the reason in ``telemetry()["plan"]``. On top
+    of it, :meth:`compiled_step` runs ``pure_update`` → in-jit fused
+    ``pure_sync`` (when ``axis_name`` is given) → ``pure_compute`` as ONE
+    donated cached XLA program — inside the caller's own
+    ``jit``/``shard_map`` step or standalone; untraceable updates fall
+    back to the separate-phase composition, and
+    ``METRICS_TPU_UNIFIED_PLAN=0`` restores the legacy per-module
+    planners. See ``docs/performance.md``.
 
     **Observability.** :meth:`telemetry` returns the unified, schema'd
     stats snapshot — the :meth:`compile_stats` and :meth:`sync_stats`
@@ -655,7 +676,7 @@ class Metric:
         self._state[name] = _copy_state_value(default)
         # the fresh state leaf aliases the default (and possibly jnp's
         # constant cache): the next compiled dispatch must copy before donating
-        object.__setattr__(self, "_donation_ready", False)
+        self._mark_state_mutated("add-state", schema_changed=True)
 
     def with_capacity(self, capacity: int) -> "Metric":
         """Convert every list ("cat") state into a fixed-capacity
@@ -669,7 +690,7 @@ class Metric:
         ``dim_zero_cat`` dispatch on the state type. Returns ``self``.
         """
         self._group_detach_if_stray()
-        object.__setattr__(self, "_donation_ready", False)
+        self._mark_state_mutated("with-capacity", schema_changed=True)
         for name, default in self._defaults.items():
             if isinstance(default, list):
                 if default or (isinstance(self._state.get(name), list) and self._state[name]):
@@ -817,14 +838,41 @@ class Metric:
         # and stay shared until the next reassignment (true copy-on-write).
         # The shared arrays now have an out-of-group alias, so neither side
         # may donate them until it has re-copied (compiled hot path).
-        object.__setattr__(self, "_donation_ready", False)
+        self._mark_state_mutated("group-detach", groups_stale=True)
         for m in group.members:
-            object.__setattr__(m, "_donation_ready", False)
+            m._mark_state_mutated("group-detach")
         self._state = {k: _copy_state_value(v) for k, v in self._state.items()}
         if len(group.members) < 2:
             for m in group.members:
                 object.__setattr__(m, "_compute_group", None)
             group.members.clear()
+
+    def _mark_state_mutated(
+        self,
+        reason: str = "state-mutated",
+        schema_changed: bool = False,
+        groups_stale: bool = False,
+    ) -> None:
+        """State changed hands (restore, alias, external read/write): revoke
+        donation ownership and invalidate this instance's execution plan.
+
+        The single funnel for what used to be 20+ scattered
+        ``object.__setattr__(m, "_donation_ready", False)`` sites — every
+        mutation now routes through ``core/plan.py``'s ``plan_invalidate``
+        (generation bump + telemetry + journal), making the
+        one-invalidation-path contract auditable. ``schema_changed`` marks
+        mutations that change the state *schema* (``add_state``,
+        ``with_capacity``, ``load_state_dict``), which additionally stale
+        the compute-group partition.
+        """
+        plan_mod.mark_state_mutated(
+            self, reason, schema_changed=schema_changed, groups_stale=groups_stale
+        )
+
+    def _mark_donation_ready(self) -> None:
+        """A compiled dispatch just replaced every state leaf with buffers
+        this instance holds outright: the next dispatch may donate them."""
+        plan_mod.mark_donation_ready(self)
 
     def __getattr__(self, name: str) -> Any:
         # only called when normal lookup fails
@@ -838,9 +886,9 @@ class Metric:
                 group = d.get("_compute_group")
                 if group is not None:
                     for m in group.members:
-                        object.__setattr__(m, "_donation_ready", False)
+                        m._mark_state_mutated("state-read")
                 elif d.get("_donation_ready", False):
-                    object.__setattr__(self, "_donation_ready", False)
+                    self._mark_state_mutated("state-read")
             return state[name]
         raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
 
@@ -857,7 +905,7 @@ class Metric:
             # the assigned value may alias anything (a user array, another
             # state, a default): copy before the next donating dispatch
             if self.__dict__.get("_donation_ready", False):
-                object.__setattr__(self, "_donation_ready", False)
+                self._mark_state_mutated("state-write")
             state[name] = value
         else:
             object.__setattr__(self, name, value)
@@ -969,6 +1017,25 @@ class Metric:
             return False
         return state_poisoned(self._state)
 
+    def _attribute_plan(self, state: Dict[str, Any]) -> None:
+        """Attribute this schema's plan build/hit to OUR telemetry registry.
+
+        The bucketed host sync consults the unified plan store deep inside
+        ``host_sync_state`` where no owner object is in scope (background
+        overlap threads included), so the owning metric warms the store
+        here — one cached lookup — and the ``plan`` telemetry domain's
+        ``builds``/``cache_hits`` land on the right registry. Skipped when
+        the fused-sync knob is off: the per-leaf escape hatch never reads
+        the plan, and the counters must not claim engagement that will not
+        happen."""
+        from metrics_tpu.core.plan import plan_for
+        from metrics_tpu.parallel.bucketing import fused_sync_enabled
+
+        knob = getattr(self, "sync_fused", None)
+        engaged = fused_sync_enabled() if knob is None else bool(knob)
+        if engaged:
+            plan_for(state, self._reductions, owner=self)
+
     def _run_dist_sync(
         self,
         state: Dict[str, Any],
@@ -989,6 +1056,7 @@ class Metric:
 
             with sync_channel():
                 return fn(state, self._reductions)
+        self._attribute_plan(state)
         return host_sync_state(
             state,
             self._reductions,
@@ -1370,7 +1438,7 @@ class Metric:
             round_ = self.__dict__["_inflight"]
             self._cache = {k: _copy_state_value(v) for k, v in self._state.items()}
             self._sync_degraded = False
-            object.__setattr__(self, "_donation_ready", False)
+            self._mark_state_mutated("serve-local")
             for name, v in round_.snapshot.items():
                 self._state[name] = v
             self._is_synced = True
@@ -1384,12 +1452,19 @@ class Metric:
         on_missing: Optional[str] = None,
     ) -> None:
         """Launch one round over ``snapshot`` (ownership transferred)."""
-        object.__setattr__(self, "_sync_epoch", getattr(self, "_sync_epoch", 0) + 1)
+        # the round's epoch is plan-layer bookkeeping: the plan binding owns
+        # the counter and mirrors it onto ``_sync_epoch`` (the health-word
+        # header column every rank cross-checks at resolve time)
+        plan_mod.next_sync_epoch(self)
         fn = dist_sync_fn or self.dist_sync_fn
         sync_fn = None
         if fn is not None:
             reductions = self._reductions
             sync_fn = lambda: fn(snapshot, reductions)  # noqa: E731
+        else:
+            # warm + attribute the schema plan NOW, on the launching thread:
+            # the background gather consults the store with no owner in scope
+            self._attribute_plan(snapshot)
         round_ = launch_round(
             snapshot,
             self._reductions,
@@ -1613,6 +1688,30 @@ class Metric:
         new_state = self.merge_states(state, batch_state)
         return new_state, value
 
+    def compiled_step(
+        self,
+        state: Dict[str, Any],
+        *args: Any,
+        axis_name: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> Tuple[Dict[str, Any], Any]:
+        """The whole-step fused program: ``update + in-jit sync(fused) +
+        compute`` as ONE cached XLA program over an explicit state pytree.
+
+        Returns ``(new_state, values)`` where ``values`` is what a blocking
+        ``sync(); compute()`` over the accumulation would serve. Called
+        inside a jit/pjit/``shard_map`` step it inlines into the user's one
+        program (pass ``axis_name`` to sync over the mapped mesh axis);
+        called eagerly it dispatches a cached program with the state
+        donated — thread ``new_state`` forward like a scan carry. Differs
+        from :meth:`pure_forward` in that the computed value reflects the
+        *accumulated* (and synced) state, not the single batch, so a
+        periodic ``compute()`` adds zero extra dispatches. Managed by
+        ``core/plan.py`` (``METRICS_TPU_UNIFIED_PLAN=0`` restores the
+        legacy separate-phase composition); see bench config 15.
+        """
+        return plan_mod.compiled_step(self, state, args, kwargs, axis_name=axis_name)
+
     # ------------------------------------------------------------------
     # compiled eager hot path (auto-JIT update/forward, donated state)
     # ------------------------------------------------------------------
@@ -1621,9 +1720,13 @@ class Metric:
         disp = self.__dict__.get("_compiled")
         if disp is None:
             # the dispatcher counts straight into the telemetry registry's
-            # "compile" domain: compile_stats()/telemetry() read ONE storage
+            # "compile" domain and stores its programs in the plan binding:
+            # compile_stats()/telemetry() read ONE storage, and the program
+            # cache is a view into the unified execution plan
             disp = CompiledDispatcher(
-                type(self).__name__, registry_of(self).domain("compile")
+                type(self).__name__,
+                registry_of(self).domain("compile"),
+                binding=plan_mod.binding(self),
             )
             object.__setattr__(self, "_compiled", disp)
         return disp
@@ -1805,7 +1908,7 @@ class Metric:
             st[name] = new_state[name]
         # the outputs are buffers this dispatch owns outright: the next one
         # may donate them without a protective copy
-        object.__setattr__(self, "_donation_ready", True)
+        self._mark_donation_ready()
         _raise_on_catbuffer_overflow(st, type(self).__name__)
         return True, value
 
@@ -1983,7 +2086,7 @@ class Metric:
         # restored leaves alias whatever `state` came from (a sync cache, a
         # merged snapshot, defaults): the next compiled dispatch must copy
         # before donating, or donation would invalidate the source's arrays
-        object.__setattr__(self, "_donation_ready", False)
+        self._mark_state_mutated("restore")
         for k, v in state.items():
             self._state[k] = _copy_state_value(v)
 
@@ -2042,8 +2145,8 @@ class Metric:
         # clone and the original can share state buffers — neither may donate
         # them until it has re-copied
         _reset_compiled_for_copy(new)
-        object.__setattr__(new, "_donation_ready", False)
-        object.__setattr__(self, "_donation_ready", False)
+        new._mark_state_mutated("deepcopy")
+        self._mark_state_mutated("deepcopy")
         return new
 
     # ------------------------------------------------------------------
@@ -2076,8 +2179,8 @@ class Metric:
         group = self.__dict__.get("_compute_group")
         if group is not None:
             for m in group.members:
-                object.__setattr__(m, "_donation_ready", False)
-        object.__setattr__(self, "_donation_ready", False)
+                m._mark_state_mutated("state-dict")
+        self._mark_state_mutated("state-dict")
         out: Dict[str, Any] = {}
         for name in self._defaults:
             if not self._persistent[name]:
@@ -2123,7 +2226,7 @@ class Metric:
                 )
         self._group_detach_if_stray()
         # loaded leaves alias the caller's checkpoint arrays: copy-before-donate
-        object.__setattr__(self, "_donation_ready", False)
+        self._mark_state_mutated("load-state-dict", schema_changed=True)
         for name in self._defaults:
             key = prefix + name
             if key in state_dict:
@@ -2289,10 +2392,12 @@ class Metric:
         # a future cannot pickle: drain any in-flight round symmetrically
         # (fold-back preserves the accumulation) before serializing
         self._drain_rounds_for_copy()
+        # _plan_binding holds jitted programs (unpicklable, and they close
+        # over this instance) — the unpickled copy re-creates a fresh one
         state = {
             k: v
             for k, v in self.__dict__.items()
-            if k not in ("update", "compute", "_inflight_collection")
+            if k not in ("update", "compute", "_inflight_collection", "_plan_binding")
         }
         state["_state"] = apply_to_collection(self._state, (jnp.ndarray,), np.asarray)
         state["_defaults"] = apply_to_collection(self._defaults, (jnp.ndarray,), np.asarray)
@@ -2302,7 +2407,7 @@ class Metric:
     def __setstate__(self, state: Dict[str, Any]) -> None:
         self.__dict__.update(state)
         _reset_compiled_for_copy(self)
-        self.__dict__["_donation_ready"] = False
+        self._mark_state_mutated("unpickle")
         self._state = apply_to_collection(self._state, (np.ndarray,), jnp.asarray)
         self._defaults = apply_to_collection(self._defaults, (np.ndarray,), jnp.asarray)
         self._cache = apply_to_collection(self._cache, (np.ndarray,), jnp.asarray)
@@ -2569,7 +2674,10 @@ def _wrap_update(update: Callable) -> Callable:
 def _wrap_compute(compute: Callable) -> Callable:
     @functools.wraps(compute)
     def wrapped_func(self: Metric, *args: Any, **kwargs: Any) -> Any:
-        if not self._update_called:
+        if not self._update_called and not self.__dict__.get("_pure_mode", False):
+            # the warning tracks the STATEFUL accumulation; a pure compute
+            # runs over an explicit caller-provided state pytree (fused
+            # steps, scan carries) where the instance latch says nothing
             rank_zero_warn(
                 f"The ``compute`` method of metric {type(self).__name__} was called before "
                 "the ``update`` method which may lead to errors, as metric states have not "

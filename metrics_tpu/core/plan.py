@@ -1,0 +1,533 @@
+"""One execution plan — the unified schema-keyed planner (ROADMAP item 1).
+
+Four subsystems used to plan independently off the same health-word schema
+(``parallel/health.py`` ``state_schema_parts``): compute-group partitioning
+(``core/collections.py``), bucketed sync layout (``parallel/bucketing.py``),
+compiled-dispatch program caching (``core/compiled.py``), and the overlapped
+round's epoch bookkeeping (``parallel/async_sync.py`` via ``core/metric.py``).
+Each carried its own cache, its own invalidation flags, and its own fallback
+ladder — so every cross-cutting feature had to thread through all four.
+
+This module replaces the four caches with ONE store and the
+``_donation_ready`` / group-detach / stale-flag patchwork with ONE
+invalidation entry point:
+
+- :class:`ExecutionPlan` — one per state schema, cached process-wide keyed
+  on the exact schema string behind the health word's CRC (the full string,
+  so a CRC collision can never alias two schemas onto one plan). It owns the
+  bucketed-sync layout (reduce buckets, cat padding, header columns — built
+  by ``parallel/bucketing.py``'s classifier, now a *view* over this store).
+- :class:`PlanBinding` — the per-``Metric``/per-``MetricCollection`` view:
+  the compiled dispatch program namespace (``core/compiled.py``'s
+  ``CompiledDispatcher`` stores its programs here), the async round's
+  ``sync_epoch`` counter, the compute-group partition flags, and the
+  monotone ``generation`` bumped by every invalidation.
+- :func:`plan_invalidate` — THE single invalidation path. Every state
+  mutation routes here via ``Metric._mark_state_mutated`` (satellite of the
+  same PR): donation ownership is revoked, the binding generation bumps,
+  and a schema-changing mutation additionally marks the compute-group
+  partition stale. The call is registered with metricslint's schedule pass
+  (``asymmetric-schedule-decision``): an invalidation gated on the process
+  index or per-rank data would legally desynchronize the planners across
+  ranks, so call sites must be guard-clean — exactly like
+  ``commit_schedule_decision`` in ``parallel/resilience.py``.
+- :func:`compiled_step` — the whole-step fused program on top of the
+  unified plan: ``update + sync_in_jit(fused=True) + compute`` traced and
+  cached as ONE donated XLA program (bench config 15). Called inside the
+  user's jit/pjit/``shard_map`` step it inlines into that one program, so
+  XLA schedules the metric collective against metric compute and a
+  per-step ``compute()`` adds zero extra dispatches (PAPERS.md "T3" is the
+  exemplar: push the host-side overlap down into the compiled program).
+
+Telemetry: the ``plan`` domain of the unified registry
+(``observability/registry.py``) counts builds / cache hits / invalidations
+(by reason) / fused-step engagements per owner, surfaced through
+``Metric.telemetry()``; the journal records ``plan.build`` / ``plan.hit`` /
+``plan.invalidate`` events when active.
+
+``METRICS_TPU_UNIFIED_PLAN=0`` is the escape hatch: the plan store still
+serves the bucketed layouts (the classification is bit-identical either
+way), but bindings are not consulted, :func:`compiled_step` runs the legacy
+un-fused composition (separate dispatch, sync, and compute phases), and
+invalidation degrades to the bare donation-latch semantics.
+"""
+import os
+import threading
+import zlib
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from metrics_tpu.observability import journal
+from metrics_tpu.observability.registry import registry_of
+
+__all__ = [
+    "ExecutionPlan",
+    "PlanBinding",
+    "binding",
+    "clear_plans",
+    "compiled_step",
+    "fused_step_refusal",
+    "mark_donation_ready",
+    "mark_state_mutated",
+    "next_sync_epoch",
+    "peek_binding",
+    "plan_cache_info",
+    "plan_for",
+    "plan_invalidate",
+    "unified_plan_enabled",
+]
+
+#: Env escape hatch: set to 0/false/off to disable the unified-plan behaviors
+#: (fused whole-step programs, binding-consulted invalidation) and restore
+#: the legacy per-feature semantics.
+UNIFIED_PLAN_ENV = "METRICS_TPU_UNIFIED_PLAN"
+
+
+def unified_plan_enabled() -> bool:
+    """Default policy: unified plan on, unless the env knob opts out."""
+    return os.environ.get(UNIFIED_PLAN_ENV, "1").strip().lower() not in (
+        "0", "false", "off", "no",
+    )
+
+
+# ---------------------------------------------------------------------------
+# the plan store: one ExecutionPlan per schema, process-wide
+# ---------------------------------------------------------------------------
+
+
+class ExecutionPlan:
+    """Everything derivable from one state schema, built once and shared.
+
+    ``schema_key`` is the exact :func:`~metrics_tpu.parallel.health.
+    state_schema_parts` string (the collision-proof cache key);
+    ``schema_crc`` its CRC-32 — the same value the health word carries, so a
+    plan and the wire protocol can be correlated in logs. ``sync_layout`` is
+    the bucketed host-sync schedule (``parallel/bucketing.py``
+    :class:`~metrics_tpu.parallel.bucketing.SyncPlan`): reduce buckets, cat
+    padding, header columns. Plans are immutable after construction and
+    lock-protected in the store, so the async overlap layer reuses them from
+    its background thread across rounds without re-planning.
+    """
+
+    __slots__ = ("schema_key", "schema_crc", "sync_layout")
+
+    def __init__(self, schema_key: str, sync_layout: Any) -> None:
+        self.schema_key = schema_key
+        self.schema_crc = zlib.crc32(schema_key.encode()) & 0x7FFFFFFF
+        self.sync_layout = sync_layout
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"ExecutionPlan(crc={self.schema_crc}, "
+            f"buckets={getattr(self.sync_layout, 'n_buckets', 0)})"
+        )
+
+
+_PLANS: Dict[str, ExecutionPlan] = {}
+_PLANS_LOCK = threading.Lock()
+_PLAN_CACHE_MAX = 256
+_plan_stats = {"hits": 0, "misses": 0, "invalidations": 0}
+
+
+def clear_plans() -> None:
+    """Drop every cached :class:`ExecutionPlan` and zero the store counters
+    (tests / benchmarks; ``parallel.bucketing.clear_sync_plan_cache`` is the
+    long-standing alias)."""
+    with _PLANS_LOCK:
+        _PLANS.clear()
+        _plan_stats["hits"] = _plan_stats["misses"] = 0
+        _plan_stats["invalidations"] = 0
+
+
+def plan_cache_info() -> Dict[str, int]:
+    with _PLANS_LOCK:
+        return {"size": len(_PLANS), **_plan_stats}
+
+
+def plan_for(
+    state: Dict[str, Any], reductions: Dict[str, Any], owner: Any = None
+) -> ExecutionPlan:
+    """The (cached) :class:`ExecutionPlan` for this state schema.
+
+    Keyed on the exact schema string the health word hashes, so any change a
+    rank could legally make between syncs (a CatBuffer materializing its
+    item spec, a dtype cast) keys a fresh plan, while repeated syncs of the
+    same schema — every ``compute()`` of a long eval — hit the cache.
+    ``owner`` (a Metric/MetricCollection) attributes the build/hit to its
+    telemetry registry's ``plan`` domain.
+    """
+    from metrics_tpu.parallel.health import state_schema_parts
+
+    from metrics_tpu.utils.checks import _tracing_active
+
+    key = state_schema_parts(state, reductions)
+    # trace-time lookups (pure_sync(fused=True) inside a user's jit) must
+    # stay silent: journal.record refuses to fire per-compilation, and the
+    # registry counters would replay-skew the same way
+    host_side = not _tracing_active()
+    with _PLANS_LOCK:
+        plan = _PLANS.get(key)
+        if plan is not None:
+            _plan_stats["hits"] += 1
+    if plan is not None:
+        if host_side:
+            if owner is not None:
+                registry_of(owner).domain("plan")["cache_hits"] += 1
+            if journal.ACTIVE:
+                journal.record("plan.hit", schema_crc=plan.schema_crc)
+        return plan
+    from metrics_tpu.parallel.bucketing import _classify
+
+    plan = ExecutionPlan(key, _classify(state, reductions, key))
+    if host_side:
+        if owner is not None:
+            registry_of(owner).domain("plan")["builds"] += 1
+        if journal.ACTIVE:
+            journal.record(
+                "plan.build",
+                schema_crc=plan.schema_crc,
+                buckets=plan.sync_layout.n_buckets,
+            )
+            # back-compat: the bucketed-layout event predates the plan store
+            journal.record(
+                "sync.plan",
+                buckets=plan.sync_layout.n_buckets,
+                cat_leaves=len(plan.sync_layout.cat_leaves),
+            )
+    with _PLANS_LOCK:
+        _plan_stats["misses"] += 1
+        if len(_PLANS) >= _PLAN_CACHE_MAX:
+            _PLANS.pop(next(iter(_PLANS)))
+        _PLANS[key] = plan
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# per-owner bindings: the planners' view into the plan
+# ---------------------------------------------------------------------------
+
+
+class PlanBinding:
+    """Per-instance plan state: what the four planners used to scatter.
+
+    - ``programs`` / ``probed`` — the compiled-dispatch program namespace
+      (``CompiledDispatcher`` holds this binding and stores through it);
+    - ``sync_epoch`` — the overlapped round counter (mirrored onto the
+      owner's ``_sync_epoch`` attribute, which rides the health word);
+    - ``generation`` — bumped by every :func:`plan_invalidate`; cached
+      fused-step programs key on it so a schema change retraces.
+    """
+
+    __slots__ = ("label", "generation", "sync_epoch", "programs", "probed")
+
+    def __init__(self, label: str = "metric") -> None:
+        self.label = label
+        self.generation = 0
+        self.sync_epoch = 0
+        self.programs: Dict[Any, Any] = {}
+        self.probed: set = set()
+
+    # bindings never copy or pickle: cached programs close over the ORIGINAL
+    # owner, and the epoch/generation describe that instance alone. The
+    # owner's copy paths drop the binding (``_reset_compiled_for_copy``),
+    # and these guards make any stray deepcopy/pickle hand back a fresh one.
+    def __deepcopy__(self, memo: dict) -> "PlanBinding":
+        return PlanBinding(self.label)
+
+    def __reduce__(self):
+        return (PlanBinding, (self.label,))
+
+
+def binding(owner: Any) -> PlanBinding:
+    """The owner's :class:`PlanBinding` (created on first use)."""
+    b = owner.__dict__.get("_plan_binding")
+    if b is None:
+        b = PlanBinding(type(owner).__name__)
+        object.__setattr__(owner, "_plan_binding", b)
+    return b
+
+
+def peek_binding(owner: Any) -> Optional[PlanBinding]:
+    """The owner's binding if plan machinery ever engaged, else ``None``."""
+    return owner.__dict__.get("_plan_binding")
+
+
+def next_sync_epoch(owner: Any) -> int:
+    """Advance and return the owner's overlapped-round epoch.
+
+    The counter lives in the plan binding (the plan owns the async round's
+    epoch bookkeeping) and is mirrored onto the owner's ``_sync_epoch``
+    attribute — the value the health-word header carries, which pickling
+    and cloning preserve even though the binding itself never copies.
+    """
+    b = binding(owner)
+    b.sync_epoch = max(b.sync_epoch, owner.__dict__.get("_sync_epoch", 0)) + 1
+    object.__setattr__(owner, "_sync_epoch", b.sync_epoch)
+    return b.sync_epoch
+
+
+# ---------------------------------------------------------------------------
+# the single invalidation path
+# ---------------------------------------------------------------------------
+
+
+def plan_invalidate(
+    owner: Any,
+    reason: str = "state-mutated",
+    schema_changed: bool = False,
+    groups_stale: bool = False,
+) -> None:
+    """THE invalidation entry: any state mutation that revokes plan-derived
+    ownership routes here (via ``Metric._mark_state_mutated``).
+
+    Effects — deliberately rank-symmetric and collective-free (metricslint's
+    schedule pass verifies every call site commits from symmetric inputs):
+
+    - the owner's donation latch is already cleared by the caller; this
+      bumps the binding ``generation`` so cached fused-step programs and
+      any other generation-keyed view re-validate;
+    - ``schema_changed=True`` (``add_state``, ``with_capacity``,
+      ``load_state_dict``, membership changes) additionally marks the
+      compute-group partition stale for re-planning at the next dispatch;
+    - ``groups_stale=True`` marks the partition stale without a schema
+      change (a group detach, a reset back to defaults).
+
+    Cheap when no plan machinery ever engaged: a metric that never compiled,
+    grouped, or overlapped pays one dict lookup.
+    """
+    d = owner.__dict__
+    if schema_changed or groups_stale:
+        if "_groups_stale" in d:
+            object.__setattr__(owner, "_groups_stale", True)
+            if schema_changed:
+                object.__setattr__(owner, "_groups_planned", False)
+    b = d.get("_plan_binding")
+    if b is None:
+        return
+    b.generation += 1
+    with _PLANS_LOCK:
+        _plan_stats["invalidations"] += 1
+    dom = registry_of(owner).domain("plan")
+    dom["invalidations"] += 1
+    reasons = dom.setdefault("invalidate_reasons", {})
+    reasons[reason] = reasons.get(reason, 0) + 1
+    if journal.ACTIVE:
+        journal.record(
+            "plan.invalidate",
+            label=b.label,
+            reason=reason,
+            schema_changed=schema_changed,
+            generation=b.generation,
+        )
+
+
+def mark_state_mutated(
+    owner: Any,
+    reason: str = "state-mutated",
+    schema_changed: bool = False,
+    groups_stale: bool = False,
+) -> None:
+    """Clear the donation latch and notify the plan layer.
+
+    The consolidation point for the historical scattered
+    ``object.__setattr__(m, "_donation_ready", False)`` sites: restored /
+    aliased / externally-visible state means the next compiled dispatch
+    must copy before donating. The plan notification only fires on an
+    actual ownership transition (latch was set) or a schema/group change —
+    re-clearing an already-clear latch is the eager hot path's common case
+    and stays a twice-a-dict-op no-op.
+    """
+    d = owner.__dict__
+    owned = d.get("_donation_ready", False)
+    object.__setattr__(owner, "_donation_ready", False)
+    if owned or schema_changed or groups_stale:
+        plan_invalidate(
+            owner, reason, schema_changed=schema_changed, groups_stale=groups_stale
+        )
+
+
+def mark_donation_ready(owner: Any) -> None:
+    """The inverse transition: a compiled dispatch's outputs are buffers the
+    owner holds outright, so the next dispatch may donate them without a
+    protective copy. Bookkeeping only — never an invalidation."""
+    object.__setattr__(owner, "_donation_ready", True)
+
+
+# ---------------------------------------------------------------------------
+# the whole-step fused program (bench config 15)
+# ---------------------------------------------------------------------------
+
+
+def fused_step_refusal(owner: Any) -> Optional[str]:
+    """Why ``owner`` cannot run the whole-step fused program (``None`` = it
+    can). The conditions mirror the compiled eager path's static gate: the
+    pure API must be traceable with fixed-shape state."""
+    from metrics_tpu.core.collections import MetricCollection
+
+    if isinstance(owner, MetricCollection):
+        members = [m for _k, m in owner.items()]
+    else:
+        members = [owner]
+    for m in members:
+        defaults = getattr(m, "_defaults", None)
+        if not defaults:
+            return (
+                f"{type(m).__name__} declares no states "
+                "(nothing to trace into the fused step)"
+            )
+        for name, default in defaults.items():
+            if isinstance(default, list):
+                return (
+                    f"{type(m).__name__} state {name!r} is a growing list — "
+                    "use with_capacity() for a fixed-shape CatBuffer"
+                )
+        if not m._can_merge():
+            return f"{type(m).__name__} state has no algebraic merge"
+    return None
+
+
+def _maybe_record_fused(owner: Any) -> None:
+    """Count one fused-step engagement. Eager calls count per step; inside
+    the user's jit the program runs as XLA with no Python to re-enter, so
+    the inline path counts once per traced call skeleton instead (the
+    registry bump is a plain trace-time python side effect — safe; the
+    journal event stays host-side only because ``journal.record`` refuses
+    to run under an ambient trace)."""
+    from metrics_tpu.utils.checks import _tracing_active
+
+    registry_of(owner).domain("plan")["fused_steps"] += 1
+    if journal.ACTIVE and not _tracing_active():
+        journal.record("plan.fused_step", label=type(owner).__name__)
+
+
+def compiled_step(
+    owner: Any,
+    state: Dict[str, Any],
+    args: Tuple,
+    kwargs: Dict[str, Any],
+    axis_name: Optional[Any] = None,
+) -> Tuple[Dict[str, Any], Any]:
+    """One whole metric step — ``update + in-jit sync(fused) + compute`` — as
+    ONE cached, donated XLA program.
+
+    Returns ``(new_state, values)``: ``new_state`` is the accumulated state
+    (``merge``-semantics via ``pure_update``), ``values`` the cross-rank
+    result computed over the synced accumulation — i.e. what a blocking
+    ``sync(); compute()`` would serve, with the collective issued *inside*
+    the program so XLA overlaps it with the metric compute.
+
+    Two call modes:
+
+    - **inside the user's jit/pjit/shard_map step** (an ambient trace is
+      active): the traced composition inlines into the user's ONE program —
+      the tentpole's end state. ``axis_name`` must name a mapped mesh axis.
+    - **eagerly from the host**: the program is jitted with the state
+      donated and cached in the owner's plan binding, keyed on the call
+      skeleton and binding generation. ``axis_name`` is not supported here
+      (a named-axis collective needs a surrounding shard_map/pmap); use the
+      host ``sync()`` path instead.
+
+    Donation means the caller must thread the returned ``new_state``
+    forward and never reuse the ``state`` argument it passed in — the
+    standard scan-carry contract. Aliased leaves (a grouped collection's
+    deduped states) are detected per call and disable donation for that
+    dispatch only. An update that cannot trace (data-dependent shapes, a
+    python-side branch on values) is detected by the same ``eval_shape``
+    probe the compiled eager path uses, and the eager composition runs
+    instead — bit-identical, just separate dispatches.
+
+    With ``METRICS_TPU_UNIFIED_PLAN=0`` the legacy composition runs instead:
+    separate ``pure_update`` / ``pure_sync`` / ``pure_compute`` phases,
+    un-jitted from here (the caller's own jit still applies).
+    """
+    import jax
+
+    from metrics_tpu.core.compiled import rebuild_call, split_call
+    from metrics_tpu.utils.checks import _tracing_active
+    from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+    reason = fused_step_refusal(owner)
+    if reason is not None:
+        raise MetricsTPUUserError(
+            f"whole-step fused program refused for {type(owner).__name__}: "
+            f"{reason}."
+        )
+    # plan compute groups NOW, host-side: the first pure_update would
+    # otherwise build them lazily mid-trace, and the probe (rightly) refuses
+    # updates that flip instance latches
+    ensure_groups = getattr(type(owner), "_ensure_groups", None)
+    if ensure_groups is not None:
+        ensure_groups(owner)
+    if not unified_plan_enabled():
+        # legacy behavior: the same math as three separate phases
+        new_state = owner.pure_update(state, *args, **kwargs)
+        synced = (
+            owner.pure_sync(new_state, axis_name=axis_name, fused=True)
+            if axis_name is not None
+            else new_state
+        )
+        return new_state, owner.pure_compute(synced)
+
+    try:
+        treedef, dyn_ix, statics, dynamic = split_call(args, kwargs)
+    except TypeError:
+        raise MetricsTPUUserError(
+            "whole-step fused program: arguments contain unhashable "
+            "non-array values; pass arrays and hashable statics only."
+        ) from None
+
+    b = binding(owner)
+    key = ("step", axis_name, b.generation, treedef, dyn_ix, statics)
+
+    def traced(st: Dict[str, Any], dyn: Any) -> Tuple[Dict[str, Any], Any]:
+        a, kw = rebuild_call(treedef, dyn_ix, statics, dyn)
+        new_state = owner.pure_update(st, *a, **kw)
+        synced = (
+            owner.pure_sync(new_state, axis_name=axis_name, fused=True)
+            if axis_name is not None
+            else new_state
+        )
+        return new_state, owner.pure_compute(synced)
+
+    if _tracing_active():
+        # inside the user's step: inline into THEIR one program; our cache
+        # only needs to hand back a stable callable so the outer trace
+        # machinery sees one function identity per call skeleton
+        fn = b.programs.get(key)
+        if fn is None:
+            b.programs[key] = fn = traced
+            _maybe_record_fused(owner)  # once per traced call skeleton
+        return fn(state, list(dynamic))
+
+    if axis_name is not None:
+        raise MetricsTPUUserError(
+            "whole-step fused program with axis_name must run inside a "
+            "shard_map/pmap-mapped jit step (a named-axis collective has no "
+            "meaning eagerly); call compiled_step from inside the step, or "
+            "drop axis_name and use the host sync() path."
+        )
+    _maybe_record_fused(owner)
+    leaves = jax.tree_util.tree_leaves(state)
+    donate = len({id(leaf) for leaf in leaves}) == len(leaves)
+    prog_key = key + (donate,)
+    prog = b.programs.get(prog_key)
+    if prog is None:
+        from metrics_tpu.core.compiled import (
+            _ensure_persistent_compile_cache,
+            probe_traceable,
+        )
+        from metrics_tpu.core.collections import MetricCollection
+
+        members = [owner]
+        if isinstance(owner, MetricCollection):
+            members.extend(m for _k, m in owner.items())
+        untraceable = probe_traceable(traced, state, list(dynamic), members)
+        if untraceable is not None:
+            prog = untraceable  # cached refusal: eager composition from now on
+        else:
+            _ensure_persistent_compile_cache()
+            prog = jax.jit(traced, donate_argnums=(0,) if donate else ())
+        b.programs[prog_key] = prog
+    if isinstance(prog, str):
+        new_state = owner.pure_update(state, *args, **kwargs)
+        return new_state, owner.pure_compute(new_state)
+    return prog(state, list(dynamic))

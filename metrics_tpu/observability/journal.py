@@ -99,6 +99,11 @@ EVENT_KINDS: Dict[str, str] = {
     # ---- compute groups (core/collections.py) ----------------------------
     "group.form": "a compute group formed (members share one state + update)",
     "group.detach": "a member copy-on-write detached from its group",
+    # ---- unified execution plan (core/plan.py) ---------------------------
+    "plan.build": "an ExecutionPlan built for a new state schema (cache miss)",
+    "plan.hit": "an ExecutionPlan served from the unified plan cache",
+    "plan.invalidate": "a state mutation invalidated an owner's plan binding",
+    "plan.fused_step": "a whole-step fused program engaged (update+sync+compute)",
 }
 
 #: Fast emission gate — ``True`` while the ring-buffer recorder is enabled

@@ -79,6 +79,13 @@ DOMAIN_DEFAULTS: Dict[str, Dict[str, Any]] = {
         "degraded": 0,
         "errors": {},  # typed SyncError class name -> count
     },
+    "plan": {
+        "builds": 0,
+        "cache_hits": 0,
+        "invalidations": 0,
+        "invalidate_reasons": {},  # invalidation reason -> count
+        "fused_steps": 0,
+    },
 }
 
 #: Process-wide counters and gauges (no instance owns a watchdog): bumped

@@ -34,16 +34,18 @@ from construction, so every rank plans the identical combined schema):
   back to :func:`~metrics_tpu.parallel.sync.host_sync_leaf`.
 
 The static plan (leaf order, bucket membership, item shapes/sizes) is
-cached keyed on the exact schema string behind the health word's CRC
-(:func:`~metrics_tpu.parallel.health.state_schema_parts` — the full string,
-so a CRC collision can never alias two schemas onto one plan), so repeated
-``compute()`` calls pay zero re-planning. Per-rank row counts — the only
-dynamic input — ride the header gather's length columns. The cache is
-lock-protected and plans are immutable after construction, so the async
-overlap layer (``parallel/async_sync.py``) reuses them from its background
-thread across overlapped rounds — a round's snapshot has the same schema
-the blocking path would sync, so rounds hit the cached plan without
-re-planning.
+cached in the unified :class:`~metrics_tpu.core.plan.ExecutionPlan` store
+(``core/plan.py``), keyed on the exact schema string behind the health
+word's CRC (:func:`~metrics_tpu.parallel.health.state_schema_parts` — the
+full string, so a CRC collision can never alias two schemas onto one
+plan), so repeated ``compute()`` calls pay zero re-planning. Per-rank row
+counts — the only dynamic input — ride the header gather's length columns.
+The store is lock-protected and plans are immutable after construction, so
+the async overlap layer (``parallel/async_sync.py``) reuses them from its
+background thread across overlapped rounds — a round's snapshot has the
+same schema the blocking path would sync, so rounds hit the cached plan
+without re-planning. This module keeps the *classifier* (the pure layout
+builder) and the execution engine; the cache itself lives with the plan.
 
 Execution requires the caller to have *already verified* the gathered
 health words: the plan trusts cross-rank schema equality (verified via the
@@ -53,7 +55,6 @@ supported entry point; the ``METRICS_TPU_FUSED_SYNC=0`` env knob is the
 escape hatch back to the per-leaf path.
 """
 import os
-import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -64,7 +65,6 @@ from metrics_tpu.parallel.health import (
     cat_family_names,
     cat_row_count,
     header_cat_lengths,
-    state_schema_parts,
 )
 
 __all__ = [
@@ -145,21 +145,24 @@ class SyncPlan:
         return len(self.reduce_buckets) + len(self.cat_buckets)
 
 
-_PLAN_CACHE: Dict[str, SyncPlan] = {}
-_PLAN_LOCK = threading.Lock()
-_PLAN_CACHE_MAX = 256
-_plan_stats = {"hits": 0, "misses": 0}
+# The schema-keyed cache that used to live here moved into the unified plan
+# store (``core/plan.py``): one ``ExecutionPlan`` per schema owns the
+# ``SyncPlan`` layout this module builds, alongside the compiled-program and
+# compute-group bookkeeping the other planners used to cache separately.
+# These two names are the long-standing public API — kept as views.
 
 
 def clear_sync_plan_cache() -> None:
-    with _PLAN_LOCK:
-        _PLAN_CACHE.clear()
-        _plan_stats["hits"] = _plan_stats["misses"] = 0
+    from metrics_tpu.core.plan import clear_plans
+
+    clear_plans()
 
 
 def sync_plan_cache_info() -> Dict[str, int]:
-    with _PLAN_LOCK:
-        return {"size": len(_PLAN_CACHE), **_plan_stats}
+    from metrics_tpu.core.plan import plan_cache_info
+
+    info = plan_cache_info()
+    return {"size": info["size"], "hits": info["hits"], "misses": info["misses"]}
 
 
 def _classify(state: Dict[str, Any], reductions: Dict[str, Any], schema_key: str) -> SyncPlan:
@@ -215,32 +218,16 @@ def _classify(state: Dict[str, Any], reductions: Dict[str, Any], schema_key: str
 
 
 def build_sync_plan(state: Dict[str, Any], reductions: Dict[str, Any]) -> SyncPlan:
-    """The (cached) fused schedule for this state schema.
-
-    Keyed on the exact schema string the health word hashes, so any change a
-    rank could legally make between syncs (a CatBuffer materializing its
-    item spec, a dtype cast) keys a fresh plan, while repeated syncs of the
-    same schema — every ``compute()`` of a long eval — hit the cache.
+    """The (cached) fused schedule for this state schema — a view into the
+    unified :class:`~metrics_tpu.core.plan.ExecutionPlan` store, which keys
+    on the exact schema string the health word hashes, so any change a rank
+    could legally make between syncs (a CatBuffer materializing its item
+    spec, a dtype cast) keys a fresh plan, while repeated syncs of the same
+    schema — every ``compute()`` of a long eval — hit the cache.
     """
-    key = state_schema_parts(state, reductions)
-    with _PLAN_LOCK:
-        plan = _PLAN_CACHE.get(key)
-        if plan is not None:
-            _plan_stats["hits"] += 1
-            return plan
-    plan = _classify(state, reductions, key)
-    from metrics_tpu.observability import journal
+    from metrics_tpu.core.plan import plan_for
 
-    if journal.ACTIVE:
-        journal.record(
-            "sync.plan", buckets=plan.n_buckets, cat_leaves=len(plan.cat_leaves),
-        )
-    with _PLAN_LOCK:
-        _plan_stats["misses"] += 1
-        if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
-            _PLAN_CACHE.pop(next(iter(_PLAN_CACHE)))
-        _PLAN_CACHE[key] = plan
-    return plan
+    return plan_for(state, reductions).sync_layout
 
 
 def _local_flat_rows(value: Any, spec: LeafSpec):

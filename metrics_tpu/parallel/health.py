@@ -231,9 +231,14 @@ def state_schema_parts(state: Dict[str, Any], reductions: Dict[str, Any]) -> str
     everything that must agree across ranks for the payload gathers to be
     well-formed. Leading ("data") dims of cat-family states are excluded so
     legitimately uneven per-rank batches serialize equal. Also the cache key
-    of the bucketed sync planner (``parallel/bucketing.py``): keying on the
-    full string instead of the 31-bit CRC makes a hash collision harmless
-    (two colliding schemas could otherwise share a plan and corrupt a sync).
+    of the unified execution-plan store (``core/plan.py``, which owns the
+    bucketed-sync layout ``parallel/bucketing.py`` used to cache itself):
+    keying on the full string instead of the 31-bit CRC makes a hash
+    collision harmless (two colliding schemas could otherwise share a plan
+    and corrupt a sync). :func:`state_schema_hash` of this same string is
+    BOTH the health word's schema column and ``ExecutionPlan.schema_crc``,
+    so a ``plan.build``/``plan.hit`` journal event correlates directly with
+    the schema CRC a failed health check reports.
     """
     from metrics_tpu.core.cat_buffer import CatBuffer
 

@@ -138,9 +138,22 @@ def sync_in_jit(  # metricslint: disable=data-dependent-collective
     each bucket into ONE flat ``psum``/``pmean``/``pmax``/``pmin``, so a
     shard_map program emits O(#dtypes × #fx-classes) collective ops for XLA
     to schedule instead of one per leaf — elementwise over the same mesh
-    axis, so results are identical to the per-leaf collectives.
+    axis, so results are identical to the per-leaf collectives. The
+    partition itself comes from the unified execution plan
+    (``core/plan.py`` via :func:`~metrics_tpu.parallel.bucketing.build_sync_plan`):
+    the in-jit fused sync and the host bucketed gather share ONE
+    schema-keyed layout decision instead of re-deriving it per trace.
     """
     from metrics_tpu.core.cat_buffer import CatBuffer, sync_cat_buffer_in_jit
+
+    bucket_of: Dict[str, Any] = {}
+    if fused:
+        from metrics_tpu.parallel.bucketing import build_sync_plan
+
+        layout = build_sync_plan(state, reductions)
+        for bkey, specs in layout.reduce_buckets.items():
+            for spec in specs:
+                bucket_of[spec.name] = bkey
 
     out: Dict[str, Any] = {}
     buckets: Dict[Any, list] = {}
@@ -157,9 +170,9 @@ def sync_in_jit(  # metricslint: disable=data-dependent-collective
                 out[name] = [fx(value, axis_name)]
             else:
                 out[name] = [sync_leaf_in_jit(value, "cat", axis_name)]
-        elif fused and fx in ("sum", "mean", "max", "min"):
+        elif name in bucket_of and fx in ("sum", "mean", "max", "min"):
             arr = jnp.asarray(value)
-            buckets.setdefault((str(arr.dtype), fx), []).append((name, arr))
+            buckets.setdefault(bucket_of[name], []).append((name, arr))
         else:
             out[name] = sync_leaf_in_jit(value, fx, axis_name)
     for (_dtype, fx), leaves in buckets.items():

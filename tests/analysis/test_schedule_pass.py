@@ -281,3 +281,31 @@ def test_shipped_resilience_module_verifies():
     findings, errors = analyze_paths([os.path.join(pkg, "parallel", "resilience.py")])
     assert not errors
     assert [f for f in findings if f.rule == "asymmetric-schedule-decision"] == []
+
+
+def test_plan_invalidation_fixture_covers_asymmetric_schedule_decision():
+    owners = by_function(findings_for("violating_plan_invalidation.py"))
+    assert owners["rank_dependent_invalidation"] == {"asymmetric-schedule-decision"}
+    assert owners["data_dependent_invalidation"] == {"asymmetric-schedule-decision"}
+    assert owners["data_derived_reason"] == {"asymmetric-schedule-decision"}
+    assert owners["latch_governed_invalidation"] == {"asymmetric-schedule-decision"}
+    # symmetric inputs (world size) invalidate cleanly
+    assert "clean_symmetric_invalidation" not in owners
+
+
+def test_shipped_plan_module_verifies():
+    """Every plan invalidation the runtime ships commits from symmetric
+    inputs (add/remove members, capacity conversion, restore, reset) — the
+    schedule-decision rule passes over core/plan.py and the call sites in
+    core/collections.py."""
+    import metrics_tpu
+
+    pkg = os.path.dirname(metrics_tpu.__file__)
+    findings, errors = analyze_paths(
+        [
+            os.path.join(pkg, "core", "plan.py"),
+            os.path.join(pkg, "core", "collections.py"),
+        ]
+    )
+    assert not errors
+    assert [f for f in findings if f.rule == "asymmetric-schedule-decision"] == []

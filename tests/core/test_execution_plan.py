@@ -1,0 +1,331 @@
+"""Unified execution plan (ISSUE 17 tentpole): equivalence + lifecycle suite.
+
+The contract under test (``core/plan.py`` + the wiring in ``core/metric.py``,
+``core/collections.py``, ``parallel/bucketing.py``, ``parallel/sync.py``):
+
+- ONE schema-keyed store: ``build_sync_plan`` is a view over
+  ``plan_for(...).sync_layout`` — same object identity, shared hit/miss
+  counters, one ``clear_plans`` lifecycle.
+- ``compiled_step`` — update + (in-jit fused sync) + compute as one cached
+  donated XLA program — is bit-identical to the separate ``pure_update`` /
+  ``pure_sync`` / ``pure_compute`` composition, eagerly and inside a
+  ``shard_map``-mapped jit, for plain metrics and grouped collections; an
+  untraceable update falls back to the eager composition with identical
+  results.
+- ``METRICS_TPU_UNIFIED_PLAN=0`` restores the legacy separate-phase
+  composition exactly (and caches no programs).
+- every donation/stale-flag invalidation routes through
+  ``plan.mark_state_mutated`` / ``plan.plan_invalidate``: generation bumps,
+  reasons are counted in the ``plan`` telemetry domain, epochs stay
+  monotonic, bindings never pickle or deepcopy their programs.
+- real two-rank payloads (``LockstepWorld``) accumulated via the unified
+  path host-sync bit-identically to the legacy composition's states.
+"""
+import copy
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.parallel.sync as sync_mod
+from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall, Specificity
+from metrics_tpu.core import plan as plan_mod
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.parallel.bucketing import build_sync_plan, sync_plan_cache_info
+from metrics_tpu.parallel.sync import host_sync_state
+from metrics_tpu.utils.exceptions import MetricsTPUUserError
+from tests.helpers.fake_world import LockstepWorld
+
+rng = np.random.RandomState(23)
+N_STEPS = 4
+BATCH = 32
+NUM_CLASSES = 10
+PREDS = [jnp.asarray(rng.randint(0, NUM_CLASSES, (BATCH,))) for _ in range(N_STEPS)]
+TARGET = [jnp.asarray(rng.randint(0, NUM_CLASSES, (BATCH,))) for _ in range(N_STEPS)]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plan_store():
+    plan_mod.clear_plans()
+    yield
+    plan_mod.clear_plans()
+
+
+class SumMetric(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + jnp.asarray(x.shape[0], jnp.int32)
+
+    def compute(self):
+        return self.total / self.count
+
+
+def _collection():
+    return MetricCollection(
+        {
+            "prec": Precision(num_classes=NUM_CLASSES, average="macro"),
+            "rec": Recall(num_classes=NUM_CLASSES, average="macro"),
+            "f1": F1(num_classes=NUM_CLASSES, average="macro"),
+            "spec": Specificity(num_classes=NUM_CLASSES, average="macro"),
+        }
+    )
+
+
+def _leaves_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        x, y = np.asarray(x), np.asarray(y)
+        assert x.dtype == y.dtype and x.shape == y.shape
+        assert x.tobytes() == y.tobytes()
+
+
+def _reference_run(owner, steps=N_STEPS):
+    """The separate-phase composition the fused program must reproduce."""
+    state = owner.init_state()
+    values = None
+    for i in range(steps):
+        state = owner.pure_update(state, PREDS[i], TARGET[i])
+        values = owner.pure_compute(state)
+    return state, values
+
+
+# ---------------------------------------------------------------------------
+# one schema-keyed store
+# ---------------------------------------------------------------------------
+
+
+def test_build_sync_plan_is_a_view_over_the_plan_store():
+    m = SumMetric()
+    state, reds = m.init_state(), m._reductions
+    layout = build_sync_plan(state, reds)
+    plan = plan_mod.plan_for(state, reds)
+    assert plan.sync_layout is layout  # same cached object, not a copy
+    info = plan_mod.plan_cache_info()
+    assert info["size"] == 1 and info["misses"] == 1 and info["hits"] >= 1
+    # the bucketing module's legacy info surface filters the same counters
+    view = sync_plan_cache_info()
+    assert set(view) == {"size", "hits", "misses"}
+    assert view["size"] == info["size"] and view["misses"] == info["misses"]
+    plan_mod.clear_plans()
+    assert plan_mod.plan_cache_info() == {
+        "size": 0,
+        "hits": 0,
+        "misses": 0,
+        "invalidations": 0,
+    }
+
+
+def test_schema_crc_matches_health_word_hash():
+    from metrics_tpu.parallel.health import state_schema_hash
+
+    m = SumMetric()
+    plan = plan_mod.plan_for(m.init_state(), m._reductions)
+    assert plan.schema_crc == state_schema_hash(m.init_state(), m._reductions)
+
+
+def test_distinct_schemas_get_distinct_plans():
+    a, b = SumMetric(), Accuracy(num_classes=NUM_CLASSES)
+    pa = plan_mod.plan_for(a.init_state(), a._reductions)
+    pb = plan_mod.plan_for(b.init_state(), b._reductions)
+    assert pa is not pb and pa.schema_key != pb.schema_key
+    assert plan_mod.plan_cache_info()["size"] == 2
+
+
+# ---------------------------------------------------------------------------
+# invalidation funnel + lifecycle
+# ---------------------------------------------------------------------------
+
+
+def test_mark_state_mutated_clears_latch_and_bumps_generation():
+    m = SumMetric()
+    binding = plan_mod.binding(m)
+    g0 = binding.generation
+    m._mark_donation_ready()
+    assert m.__dict__["_donation_ready"] is True
+    m._mark_state_mutated("state-read")
+    assert m.__dict__["_donation_ready"] is False
+    assert binding.generation == g0 + 1
+    # not owned and no schema/group change: nothing to invalidate
+    m._mark_state_mutated("state-read")
+    assert binding.generation == g0 + 1
+    reasons = m.telemetry()["plan"]["invalidate_reasons"]
+    assert reasons.get("state-read") == 1
+
+
+def test_collection_membership_changes_route_through_plan_invalidate():
+    col = _collection()
+    binding = plan_mod.binding(col)
+    g0 = binding.generation
+    col.add_metrics({"acc": Accuracy(num_classes=NUM_CLASSES)})
+    assert binding.generation == g0 + 1
+    assert col.__dict__["_groups_stale"] is True
+    reasons = col.telemetry()["collection"]["plan"]["invalidate_reasons"]
+    assert reasons.get("membership-changed", 0) >= 1
+
+
+def test_sync_epoch_is_monotonic_and_mirrored():
+    m = SumMetric()
+    e1 = plan_mod.next_sync_epoch(m)
+    e2 = plan_mod.next_sync_epoch(m)
+    assert e2 == e1 + 1
+    assert m.__dict__["_sync_epoch"] == e2
+    assert plan_mod.binding(m).sync_epoch == e2
+
+
+def test_binding_never_copies_or_pickles_its_programs():
+    m = SumMetric()
+    st = m.init_state()
+    st, _ = m.compiled_step(st, jnp.ones((BATCH,), jnp.float32))
+    assert plan_mod.peek_binding(m).programs  # something cached
+    for clone in (copy.deepcopy(m), pickle.loads(pickle.dumps(m))):
+        b = plan_mod.peek_binding(clone)
+        assert b is None or not b.programs
+
+
+# ---------------------------------------------------------------------------
+# whole-step fused program ≡ separate phases
+# ---------------------------------------------------------------------------
+
+
+def test_compiled_step_metric_bit_identical_to_composition():
+    m = SumMetric()
+    state = m.init_state()
+    for i in range(N_STEPS):
+        state, values = m.compiled_step(state, PREDS[i].astype(jnp.float32))
+    ref = SumMetric()
+    rstate = ref.init_state()
+    for i in range(N_STEPS):
+        rstate = ref.pure_update(rstate, PREDS[i].astype(jnp.float32))
+    _leaves_equal(state, rstate)
+    _leaves_equal(values, ref.pure_compute(rstate))
+    # ONE program cached, and it is a real jitted program (no fallback)
+    progs = list(plan_mod.peek_binding(m).programs.values())
+    assert len(progs) == 1 and not isinstance(progs[0], str)
+
+
+def test_compiled_step_grouped_collection_bit_identical():
+    col = _collection()
+    state = col.init_state()
+    for i in range(N_STEPS):
+        state, values = col.compiled_step(state, PREDS[i], TARGET[i])
+    rstate, rvalues = _reference_run(_collection())
+    _leaves_equal(state, rstate)
+    assert sorted(values) == sorted(rvalues)
+    for k in rvalues:
+        _leaves_equal(values[k], rvalues[k])
+    progs = list(plan_mod.peek_binding(col).programs.values())
+    assert progs and all(not isinstance(p, str) for p in progs)
+    tele = col.telemetry()["collection"]["plan"]
+    assert tele["fused_steps"] == N_STEPS
+
+
+def test_untraceable_update_falls_back_to_eager_composition():
+    m = Accuracy()  # infers num_classes from data: cannot trace
+    state = m.init_state()
+    for i in range(N_STEPS):
+        state, values = m.compiled_step(state, PREDS[i], TARGET[i])
+    rstate, rvalues = _reference_run(Accuracy())
+    _leaves_equal(state, rstate)
+    _leaves_equal(values, rvalues)
+    progs = list(plan_mod.peek_binding(m).programs.values())
+    assert progs and all(isinstance(p, str) for p in progs)  # cached refusal
+
+
+def test_escape_hatch_restores_legacy_composition(monkeypatch):
+    monkeypatch.setenv(plan_mod.UNIFIED_PLAN_ENV, "0")
+    assert not plan_mod.unified_plan_enabled()
+    col = _collection()
+    state = col.init_state()
+    for i in range(N_STEPS):
+        state, values = col.compiled_step(state, PREDS[i], TARGET[i])
+    rstate, rvalues = _reference_run(_collection())
+    _leaves_equal(state, rstate)
+    for k in rvalues:
+        _leaves_equal(values[k], rvalues[k])
+    b = plan_mod.peek_binding(col)
+    assert b is None or not b.programs  # legacy path caches nothing
+
+
+def test_eager_axis_name_is_a_user_error():
+    m = SumMetric()
+    with pytest.raises(MetricsTPUUserError):
+        m.compiled_step(m.init_state(), jnp.ones((4,), jnp.float32), axis_name="w")
+
+
+def test_compiled_step_inside_users_jit_with_fused_sync():
+    """Inside a shard_map-mapped jit the step inlines into the user's ONE
+    program and the in-jit fused sync consults the same plan store."""
+    from functools import partial
+
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("w",))
+    col = _collection()
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=(P("w"), P("w", None), P("w", None)), out_specs=(P("w"), P()))
+    def step(state, p, t):
+        st = jax.tree_util.tree_map(lambda x: x[0], state)
+        ns, vals = col.compiled_step(st, p[0], t[0], axis_name="w")
+        return jax.tree_util.tree_map(lambda x: x[None], ns), vals
+
+    state = jax.tree_util.tree_map(lambda x: x[None], col.init_state())
+    for i in range(N_STEPS):
+        state, values = step(state, PREDS[i][None], TARGET[i][None])
+    rstate, rvalues = _reference_run(_collection())
+    _leaves_equal(jax.tree_util.tree_map(lambda x: x[0], state), rstate)
+    for k in rvalues:
+        _leaves_equal(values[k], rvalues[k])
+    # the fused in-jit sync planned through the unified store
+    assert plan_mod.plan_cache_info()["size"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# two-rank LockstepWorld: unified accumulation syncs bit-identically
+# ---------------------------------------------------------------------------
+
+WORLD = 2
+
+
+@pytest.fixture
+def lockstep(monkeypatch):
+    world = LockstepWorld(WORLD)
+    monkeypatch.setattr(jax, "process_count", lambda: world.world)
+    monkeypatch.setattr(sync_mod, "_raw_process_allgather", world.allgather)
+    return world
+
+
+def test_lockstep_unified_vs_legacy_host_sync_bit_identical(lockstep, monkeypatch):
+    """Each rank accumulates its shard through the fused whole-step program;
+    the host-synced result equals the legacy separate-phase accumulation,
+    bit for bit, on every rank — and fused vs per-leaf gathers agree."""
+
+    def unified_body(rank):
+        m = SumMetric()
+        state = m.init_state()
+        for i in range(N_STEPS):
+            state, _ = m.compiled_step(state, PREDS[i].astype(jnp.float32) + rank)
+        return host_sync_state(state, m._reductions, update_count=N_STEPS, timeout=0, fused=True)
+
+    def legacy_body(rank):
+        m = SumMetric()
+        state = m.init_state()
+        for i in range(N_STEPS):
+            state = m.pure_update(state, PREDS[i].astype(jnp.float32) + rank)
+        return host_sync_state(state, m._reductions, update_count=N_STEPS, timeout=0, fused=False)
+
+    unified = lockstep.run(unified_body)
+    legacy = lockstep.run(legacy_body)
+    for rank in range(WORLD):
+        _leaves_equal(unified[rank], legacy[rank])
+    _leaves_equal(unified[0], unified[1])  # collectives are symmetric
+    assert plan_mod.plan_cache_info()["hits"] >= 1  # ranks shared ONE plan

@@ -58,11 +58,12 @@ class _Sum(Metric):
         return self.total
 
 
-def rank_kinds(rank, exclude=("sync.plan",)):
-    """This rank's (kind, epoch) sequence. ``sync.plan`` is excluded: the
-    plan cache is per PROCESS in production, but LockstepWorld's fake ranks
-    share one module-level cache, so which fake rank records the one build
-    is a harness artifact, not a protocol fact."""
+def rank_kinds(rank, exclude=("sync.plan", "plan.build", "plan.hit")):
+    """This rank's (kind, epoch) sequence. ``sync.plan`` and the execution
+    plan store's ``plan.build``/``plan.hit`` are excluded: the plan cache is
+    per PROCESS in production, but LockstepWorld's fake ranks share one
+    module-level cache, so which fake rank records the one build (and which
+    records a hit) is a harness artifact, not a protocol fact."""
     return [
         (e.kind, e.fields.get("sync_epoch"))
         for e in journal.events(rank=rank)
