@@ -1324,12 +1324,33 @@ def bench_config8() -> None:
 
     # W=8 sync-term bound: host collectives ride DCN with a per-collective
     # launch floor that dominates small metric payloads — which is exactly
-    # why collective COUNT is the lever this config measures
-    launch_ms, dcn_gbps = 1.0, 3.0
+    # why collective COUNT is the lever this config measures. The knobs are
+    # env-overridable so site operators can re-derive the bound for their
+    # own fabric without editing the bench.
+    launch_ms = float(os.environ.get("METRICS_TPU_BENCH_LAUNCH_MS", "1.0"))
+    dcn_gbps = float(os.environ.get("METRICS_TPU_BENCH_DCN_GBPS", "3.0"))
+    intra_launch_ms = float(os.environ.get("METRICS_TPU_BENCH_INTRA_LAUNCH_MS", "0.1"))
     bound = {
         mode: round(c["collectives"] * launch_ms + c["bytes"] / (dcn_gbps * 1e9) * 1e3, 3)
         for mode, c in counts.items()
     }
+    # Tiered two-term bound (the hierarchical schedule of ISSUE 20): with a
+    # tier map of size TIER the slow-wire traffic shrinks by
+    # (n_tiers-1)/(W-1) — each payload crosses DCN once per inter-tier peer
+    # instead of once per world peer — while two extra fast hops per bucket
+    # ride the intra-tier wire at its (much lower) launch floor.
+    TIER = 4
+    n_tiers = W // TIER
+    tiered_bound = {}
+    for mode, c in counts.items():
+        inter_bytes = c["bytes"] * (n_tiers - 1) / (W - 1)
+        intra_ms = c["collectives"] * 2 * intra_launch_ms
+        inter_ms = c["collectives"] * launch_ms + inter_bytes / (dcn_gbps * 1e9) * 1e3
+        tiered_bound[mode] = {
+            "intra_ms": round(intra_ms, 3),
+            "inter_ms": round(inter_ms, 3),
+            "total_ms": round(intra_ms + inter_ms, 3),
+        }
     _diag(
         config=8,
         world=W,
@@ -1339,7 +1360,12 @@ def bench_config8() -> None:
         fused_collectives=fused_n,
         payload_bytes={m: c["bytes"] for m, c in counts.items()},
         sync_term_w8_ms_bound=bound,
-        assumed={"launch_ms_per_collective": launch_ms, "dcn_gbps": dcn_gbps},
+        tiered_sync_term_w8_ms_bound={"tier_size": TIER, **tiered_bound},
+        assumed={
+            "launch_ms_per_collective": launch_ms,
+            "dcn_gbps": dcn_gbps,
+            "intra_launch_ms_per_collective": intra_launch_ms,
+        },
     )
     _emit("fused_sync_collectives", fused_n, "collectives/sync",
           round(leaf_n / fused_n, 3))
@@ -1796,13 +1822,16 @@ def bench_config12() -> None:
     compute()-every-N step-loop wall-clock + bit-identical resolved values.
 
     The ISSUE-7 acceptance measurement: a sum-state metric runs the same
-    update stream at simulated W=8 over the LockstepWorld threads harness
-    (per-rank background executor lanes, rendezvous collectives with an
-    injected per-collective DCN delay, per-step simulated train work) in
-    two modes: blocking ``compute()`` every K steps (the gather stalls the
-    step loop) and ``sync_mode="overlap"`` (each compute resolves the round
-    launched one interval earlier and relaunches — the collective rides
-    behind the K steps of work). Asserts (CI gates contract):
+    update stream at simulated W=8 over the FleetWorld threads harness
+    (per-rank background executor lanes, rendezvous collectives riding the
+    fleet's per-tier latency model — a full-world gather spans tiers, so
+    every collective pays the inter-tier ring delay ``(W-1) x hop``, the
+    principled form of the flat 3 ms injection this config used to hard
+    code — plus per-step simulated train work) in two modes: blocking
+    ``compute()`` every K steps (the gather stalls the step loop) and
+    ``sync_mode="overlap"`` (each compute resolves the round launched one
+    interval earlier and relaunches — the collective rides behind the K
+    steps of work). Asserts (CI gates contract):
 
     - the overlapped step loop's wall-clock is strictly below blocking
       (the collective is genuinely off the critical path);
@@ -1825,13 +1854,15 @@ def bench_config12() -> None:
     import metrics_tpu.parallel.sync as sync_mod
     from metrics_tpu.core.metric import Metric
     from metrics_tpu.parallel.bucketing import clear_sync_plan_cache
-    from tests.helpers.fake_world import LockstepWorld
+    from tests.helpers.fake_world import FaultProfile, FleetWorld
 
     W = 8
     K_STEPS = 5  # train steps per compute interval
     INTERVALS = 8
     STEP_S = 0.002  # simulated per-step train work
-    GATHER_S = 0.003  # injected per-collective DCN round-trip
+    TIER = 4  # fleet latency model: two tiers of four ranks
+    INTER_HOP_S = 0.0004  # per ring hop on the slow wire; a full-world
+    GATHER_S = INTER_HOP_S * (W - 1)  # gather spans tiers -> (W-1) hops ~ 2.8 ms
 
     class _Sum(Metric):
         def __init__(self, **kw):
@@ -1845,13 +1876,17 @@ def bench_config12() -> None:
             return self.total
 
     def run_mode(overlap: bool):
-        world = LockstepWorld(W)
-        real_allgather = world.allgather
-
-        def slow_allgather(x):
-            _time.sleep(GATHER_S)
-            return real_allgather(x)
-
+        # the fleet's latency model injects the DCN delay: every full-world
+        # gather spans both tiers, so each collective pays (W-1) inter-tier
+        # ring hops — the generalized form of a flat per-collective sleep
+        world = FleetWorld(
+            W,
+            FaultProfile(
+                tier_size=TIER,
+                intra_tier_latency_s=INTER_HOP_S / 20,
+                inter_tier_latency_s=INTER_HOP_S,
+            ),
+        )
         saved = (
             jax.process_count,
             sync_mod._raw_process_allgather,
@@ -1863,7 +1898,7 @@ def bench_config12() -> None:
         clear_sync_plan_cache()
         try:
             jax.process_count = lambda: W
-            sync_mod._raw_process_allgather = slow_allgather
+            sync_mod._raw_process_allgather = world.allgather
             async_mod._get_executor = world.executor_for_current_rank
             async_mod._current_domain = world.rank_domain
 
@@ -2477,6 +2512,175 @@ def bench_config15() -> None:
     )
 
 
+def bench_config16() -> None:
+    """Config 16: topology-aware hierarchical sync — tiered two-level
+    schedule vs the flat world gather at simulated W=16, tier_size=4.
+
+    The ISSUE-20 acceptance measurement: a mixed reduce+cat state dict
+    host-syncs for several rounds over a FleetWorld whose latency model
+    charges ``(k-1)`` ring hops per collective — inter-tier hops when the
+    participant set spans tiers, intra-tier hops otherwise — once with no
+    tier map (the flat path: every payload collective is a full 16-rank
+    gather on the slow wire) and once with ``set_tier_map(4)`` (the tiered
+    path: reduce-within-tier, ONE leaders-only inter-tier exchange per
+    bucket, intra-tier broadcast). Asserts (CI gates contract):
+
+    - tiered values are **bit-identical** to the flat gather's on every
+      rank (full precision moves raw blocks; same floats, fewer slow hops);
+    - the inter-tier exchange runs over n_tiers=4 participants, strictly
+      fewer than the flat gather's 16;
+    - the tiered schedule's inter-tier bytes (per-hop telemetry counters)
+      are STRICTLY below what the flat gather moves across tiers for the
+      same payloads (``inter_tier_bytes + inter_tier_bytes_saved`` — the
+      counters' own definition of the flat cost);
+    - tiered wall-clock beats flat under the fleet's tiered latency model
+      (4-participant slow hops + cheap fast hops < 16-participant slow
+      hops).
+
+    Emits ``tiered_sync_inter_tier_bytes`` with ``vs_baseline`` =
+    flat/tiered inter-tier byte ratio (>1 is a win).
+    """
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    import metrics_tpu.parallel.async_sync as async_mod
+    import metrics_tpu.parallel.sync as sync_mod
+    from metrics_tpu.core.plan import clear_plans
+    from metrics_tpu.parallel import tiering
+    from metrics_tpu.parallel.bucketing import clear_sync_plan_cache
+    from tests.helpers.fake_world import FaultProfile, FleetWorld
+
+    W, TIER, ROUNDS = 16, 4, 2
+    # hop latencies are large enough that the simulated wire dominates the
+    # 16-thread harness's Python overhead: flat pays (W-1)=15 slow hops per
+    # payload collective, tiered pays (n_tiers-1)=3 slow hops (leaders only)
+    # plus (TIER-1)=3 fast hops on each side of the exchange
+    INTER_HOP_S = 0.02  # slow-wire (DCN) per ring hop
+    INTRA_HOP_S = INTER_HOP_S / 20  # fast in-tier wire
+
+    def run_mode(tiered: bool):
+        world = FleetWorld(
+            W,
+            FaultProfile(
+                tier_size=TIER,
+                intra_tier_latency_s=INTRA_HOP_S,
+                inter_tier_latency_s=INTER_HOP_S,
+            ),
+        )
+        saved = (
+            jax.process_count,
+            sync_mod._raw_process_allgather,
+            async_mod._get_executor,
+            async_mod._current_domain,
+            tiering._current_rank,
+        )
+        clear_sync_plan_cache()
+        clear_plans()
+        tiering.reset_tiering()
+        try:
+            jax.process_count = lambda: W
+            sync_mod._raw_process_allgather = world.allgather
+            async_mod._get_executor = world.executor_for_current_rank
+            async_mod._current_domain = world.rank_domain
+            tiering._current_rank = lambda: world.rank_domain() or 0
+            if tiered:
+                tiering.set_tier_map(TIER)
+                tiering.set_tier_transport(world)
+
+            def body(rank):
+                stats = {}
+                vals = []
+                t0 = _time.perf_counter()
+                for step in range(ROUNDS):
+                    state = {
+                        "acc": jnp.arange(512, dtype=jnp.float32) * (rank + 1) + step,
+                        "cnt": jnp.asarray(rank + step + 1, jnp.int32),
+                        "rows": [jnp.arange(4 + rank % 3, dtype=jnp.float32) + rank],
+                    }
+                    synced = sync_mod.host_sync_state(
+                        state, {"acc": "sum", "cnt": "sum", "rows": "cat"},
+                        update_count=1, timeout=0, metric_name="tiered-bench",
+                        stats=stats,
+                    )
+                    vals.append(
+                        (
+                            np.asarray(synced["acc"]).tobytes(),
+                            np.asarray(synced["cnt"]).tobytes(),
+                            tuple(np.asarray(r).tobytes() for r in synced["rows"]),
+                        )
+                    )
+                elapsed = _time.perf_counter() - t0
+                topo = tiering.active_topology()
+                return vals, stats, elapsed, None if topo is None else topo.n_tiers
+            results = world.run(body, timeout=300.0)
+        finally:
+            (
+                jax.process_count,
+                sync_mod._raw_process_allgather,
+                async_mod._get_executor,
+                async_mod._current_domain,
+                tiering._current_rank,
+            ) = saved
+            tiering.reset_tiering()
+            clear_plans()
+            clear_sync_plan_cache()
+            world.shutdown_executors()
+        return results
+
+    flat = run_mode(tiered=False)
+    tiered = run_mode(tiered=True)
+
+    # bit-identity: full-precision tiered == flat, every rank, every round
+    for rank in range(W):
+        assert tiered[rank][0] == flat[rank][0], f"rank {rank} diverged"
+
+    # participants: the slow hop carries the 4 tier leaders, not 16 ranks
+    inter_participants = tiered[0][3]
+    assert inter_participants == W // TIER, inter_participants
+    assert inter_participants < W
+
+    # bytes: strictly fewer inter-tier bytes than the flat gather moves
+    # across tiers (the saved counter IS flat-minus-actual by definition)
+    tiered_inter = sum(t[1].get("inter_tier_bytes", 0) for t in tiered)
+    saved_bytes = sum(t[1].get("inter_tier_bytes_saved", 0) for t in tiered)
+    flat_inter = tiered_inter + saved_bytes
+    assert tiered_inter > 0 and saved_bytes > 0
+    assert tiered_inter < flat_inter, (tiered_inter, flat_inter)
+
+    # wall-clock: leaders-only slow hops beat 16-participant slow hops
+    wall_flat = max(r[2] for r in flat)
+    wall_tiered = max(r[2] for r in tiered)
+    assert wall_tiered < wall_flat, (
+        f"tiered step loop {wall_tiered * 1e3:.1f} ms not below flat "
+        f"{wall_flat * 1e3:.1f} ms under the tiered latency model"
+    )
+
+    _diag(
+        config=16,
+        world=W,
+        tier_size=TIER,
+        rounds=ROUNDS,
+        inter_participants={"flat": W, "tiered": inter_participants},
+        inter_tier_bytes={"flat": flat_inter, "tiered": tiered_inter},
+        intra_tier_bytes=sum(t[1].get("intra_tier_bytes", 0) for t in tiered),
+        wall_ms={"flat": round(wall_flat * 1e3, 2), "tiered": round(wall_tiered * 1e3, 2)},
+        latency_model={
+            "inter_hop_ms": INTER_HOP_S * 1e3,
+            "intra_hop_ms": INTRA_HOP_S * 1e3,
+            "ring": "(participants-1) hops per collective",
+        },
+        equality="bit-identical (full precision, reduce + cat)",
+    )
+    _emit(
+        "tiered_sync_inter_tier_bytes",
+        tiered_inter,
+        "bytes",
+        round(flat_inter / tiered_inter, 3),
+    )
+
+
 def main() -> None:
     if "--config" in sys.argv:
         # config 15's in-jit fused sync needs 8 devices; on CPU hosts that
@@ -2513,7 +2717,7 @@ def main() -> None:
     except Exception:
         vs = None
     _emit("fused_metric_step_time", round(ours * 1e6, 2), "us/step", round(vs, 3) if vs else None)
-    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10, "11": bench_config11, "12": bench_config12, "13": bench_config13, "14": bench_config14, "15": bench_config15}
+    extra = {"2": bench_config2, "3": bench_config3, "4": bench_config4, "5": bench_config5, "6": bench_config6, "7": bench_config7, "8": bench_config8, "9": bench_config9, "10": bench_config10, "11": bench_config11, "12": bench_config12, "13": bench_config13, "14": bench_config14, "15": bench_config15, "16": bench_config16}
     if "--config" in sys.argv:
         # comma-separated list (--config 9,11): related configs run in one
         # process and share compile-cache warmth (CI gates contract)

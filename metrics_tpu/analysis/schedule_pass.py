@@ -173,6 +173,25 @@ _SYMMETRIC_CALLS = COLLECTIVE_CALLS | KNOWN_EMITTING_CALLS | frozenset(
         "adaptive_sync_timeout",
         # pure classification of an already-symmetric typed failure
         "is_missing_rank_error",
+        # the tier topology (``parallel/tiering.py``) is NEGOTIATED, not
+        # ad hoc: a pure function of the agreed live set and the (config-
+        # identical-by-contract) tier map, re-verified by the health word's
+        # tier + precision columns before any payload collective. Its
+        # readers — and the plan-layer schedule derived from them — are
+        # therefore world-replicated and wash taint to schema. A raw
+        # ``process_index()``-gated hop does NOT go through these and stays
+        # a rank-tainted finding (the ``violating_tier_hop`` fixture).
+        "tier_topology",
+        "active_topology",
+        "tier_of_rank",
+        "expected_tier_column",
+        "my_tier_id",
+        "tiering_configured",
+        "active_tier_transport",
+        "tier_schedule_for",
+        "validate_sync_precision",
+        "precision_code",
+        "encoded_size",
     }
 )
 

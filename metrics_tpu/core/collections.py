@@ -224,6 +224,13 @@ class MetricCollection(dict):
     #: :attr:`Metric.staleness_policy`.
     staleness_policy: str = "snapshot"
 
+    #: Collection-level analogue of :attr:`Metric.sync_precision`: opt-in
+    #: bf16/int8 encoding of the combined bucketed payload's inter-tier
+    #: (slow-hop) wire when a tier map is configured. One value for the
+    #: whole combined gather — the health word's precision column verifies
+    #: every rank agrees.
+    sync_precision: Optional[str] = None
+
     def __init__(
         self,
         metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
@@ -233,6 +240,7 @@ class MetricCollection(dict):
         compute_groups: Union[bool, Sequence[Sequence[str]]] = True,
         sync_mode: str = "blocking",
         staleness_policy: str = "snapshot",
+        sync_precision: Optional[str] = None,
     ) -> None:
         super().__init__()
         self.prefix = self._check_arg(prefix, "prefix")
@@ -245,6 +253,9 @@ class MetricCollection(dict):
         from metrics_tpu.parallel.async_sync import validate_staleness_policy
 
         self.staleness_policy = validate_staleness_policy(staleness_policy)
+        from metrics_tpu.parallel.quantize import validate_sync_precision
+
+        self.sync_precision = validate_sync_precision(sync_precision)
         self._inflight_round = None
         self._inflight_owners: Optional[List[Tuple[str, Metric, List[Metric]]]] = None
         self._inflight_counts: Optional[Dict[str, int]] = None
@@ -1551,6 +1562,8 @@ class MetricCollection(dict):
             metric_name=f"MetricCollection[{', '.join(self.keys())}]",
             fused=True,
             on_missing=self._effective_on_missing(on_missing),
+            sync_precision=getattr(self, "sync_precision", None),
+            stats=self._sync_stats_dict(),
         )
         # snapshot each owner's pre-sync state only now: the sync never
         # mutates its inputs, and a failed attempt (the common case the
@@ -1625,6 +1638,8 @@ class MetricCollection(dict):
             timeout=self._effective_member_timeout(timeout),
             fused=True,
             on_missing=self._effective_on_missing(on_missing),
+            sync_precision=getattr(self, "sync_precision", None),
+            stats=self._sync_stats_dict(),
         )
         self._inflight_round = round_
         self._inflight_owners = owners

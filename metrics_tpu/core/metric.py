@@ -64,6 +64,7 @@ from metrics_tpu.parallel.async_sync import (
     validate_staleness_policy,
 )
 from metrics_tpu.parallel.health import NONFINITE_STATE
+from metrics_tpu.parallel.quantize import validate_sync_precision
 from metrics_tpu.parallel.sync import (
     host_sync_state,
     jit_distributed_available,
@@ -434,6 +435,14 @@ class Metric:
         staleness_policy: ``"snapshot"`` (default), ``"merge"`` or
             ``"fresh"`` — what a resolved overlapped round means when
             updates ran mid-flight (see :attr:`staleness_policy`).
+        sync_precision: ``None``/``"full"`` (default), ``"bf16"`` or
+            ``"int8"`` — opt-in quantization of the *inter-tier* (slow-hop)
+            payload when the two-level sync schedule is active
+            (``parallel/tiering.py``; flat worlds and intra-tier hops always
+            move full precision). The choice rides the health word's
+            precision column, so a fleet mixing precisions raises a typed
+            ``StateDivergenceError`` on every rank before any payload moves.
+            See ``docs/performance.md``.
         compiled_update: per-metric override of the compiled eager hot path
             (see the :attr:`compiled_update` attribute): ``None`` follows
             the ``METRICS_TPU_COMPILED_UPDATE`` env knob, ``False`` keeps
@@ -545,6 +554,14 @@ class Metric:
     #: :meth:`sync_stats` under every policy.
     staleness_policy: str = "snapshot"
 
+    #: Opt-in quantization of the tiered sync schedule's inter-tier (slow
+    #: hop) payload: ``None``/``"full"`` moves full precision everywhere
+    #: (the default — bit-identical to the flat gather), ``"bf16"``/
+    #: ``"int8"`` encode ONLY the inter-tier wire when a tier map is
+    #: configured (``parallel/tiering.py``). Negotiated through the health
+    #: word's precision column, so mixed-precision fleets fail loudly.
+    sync_precision: Optional[str] = None
+
     #: The in-flight overlapped sync round (``parallel/async_sync.py``), or
     #: ``None``. At most one per metric; launched by ``sync(blocking=False)``
     #: / the ``sync_mode="overlap"`` pipeline, consumed by the next
@@ -592,6 +609,7 @@ class Metric:
         compiled_update: Optional[bool] = None,
         sync_mode: str = "blocking",
         staleness_policy: str = "snapshot",
+        sync_precision: Optional[str] = None,
     ) -> None:
         # bypass custom __setattr__ while bootstrapping
         object.__setattr__(self, "_state", {})
@@ -621,6 +639,7 @@ class Metric:
             )
         self.sync_mode = sync_mode
         self.staleness_policy = validate_staleness_policy(staleness_policy)
+        self.sync_precision = validate_sync_precision(sync_precision)
         # overridable seam for integrations/tests: sync() fires only when this
         # reports a world (reference gates on torch.distributed initialization,
         # metric.py:274-277; here the default is multi-process JAX)
@@ -1068,6 +1087,8 @@ class Metric:
             on_missing=(
                 getattr(self, "sync_on_missing", "raise") if on_missing is None else on_missing
             ),
+            sync_precision=getattr(self, "sync_precision", None),
+            stats=self._sync_stats_dict(),
         )
 
     def sync(
@@ -1478,6 +1499,8 @@ class Metric:
             on_missing=(
                 getattr(self, "sync_on_missing", "raise") if on_missing is None else on_missing
             ),
+            sync_precision=getattr(self, "sync_precision", None),
+            stats=self._sync_stats_dict(),
         )
         object.__setattr__(self, "_inflight", round_)
         self._sync_stats_dict()["launched"] += 1

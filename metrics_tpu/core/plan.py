@@ -62,6 +62,7 @@ from metrics_tpu.observability.registry import registry_of
 __all__ = [
     "ExecutionPlan",
     "PlanBinding",
+    "TierSchedule",
     "binding",
     "clear_plans",
     "compiled_step",
@@ -73,6 +74,7 @@ __all__ = [
     "plan_cache_info",
     "plan_for",
     "plan_invalidate",
+    "tier_schedule_for",
     "unified_plan_enabled",
 ]
 
@@ -134,6 +136,7 @@ def clear_plans() -> None:
     long-standing alias)."""
     with _PLANS_LOCK:
         _PLANS.clear()
+        _TIER_SCHEDULES.clear()
         _plan_stats["hits"] = _plan_stats["misses"] = 0
         _plan_stats["invalidations"] = 0
 
@@ -199,6 +202,99 @@ def plan_for(
             _PLANS.pop(next(iter(_PLANS)))
         _PLANS[key] = plan
     return plan
+
+
+# ---------------------------------------------------------------------------
+# the tier dimension: the sync layout × the negotiated tier topology
+# ---------------------------------------------------------------------------
+
+
+class TierSchedule:
+    """One schema's two-level collective schedule over one tier topology.
+
+    The tier dimension ``build_sync_plan`` gained in the hierarchical-sync
+    PR: an :class:`ExecutionPlan`'s bucketed layout says *what* rides each
+    collective; the :class:`~metrics_tpu.parallel.tiering.TierTopology` says
+    *who* participates in each hop. This object pairs them — plus the subset
+    transport the hops run over — and precomputes the participant counts the
+    journal and bench configs compare against the flat gather:
+
+    - ``inter_participants`` — tier leaders only (``n_tiers``), vs.
+      ``flat_participants`` (every live rank) for the flat world gather;
+    - ``hops_per_bucket`` — 3 (intra gather, inter exchange, intra
+      broadcast) vs. the flat path's 1, the trade the schedule makes:
+      more launches on the fast hop to shrink the slow hop.
+
+    Cached per ``(schema string, topology key)`` in the plan store's
+    companion dict — a quorum shrink changes the topology key, so the same
+    schema re-schedules in the new membership epoch with zero collectives.
+    """
+
+    __slots__ = ("topology", "transport", "schema_key")
+
+    def __init__(self, topology: Any, transport: Any, schema_key: str) -> None:
+        self.topology = topology
+        self.transport = transport
+        self.schema_key = schema_key
+
+    @property
+    def inter_participants(self) -> int:
+        return self.topology.n_tiers
+
+    @property
+    def flat_participants(self) -> int:
+        return len(self.topology.live)
+
+    @property
+    def hops_per_bucket(self) -> int:
+        return 3
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"TierSchedule(tiers={self.topology.n_tiers}, "
+            f"live={len(self.topology.live)})"
+        )
+
+
+_TIER_SCHEDULES: Dict[Any, TierSchedule] = {}
+
+
+def tier_schedule_for(sync_plan: Any) -> Optional[TierSchedule]:
+    """The tiered schedule for one bucketed layout, or ``None`` for the flat
+    path (no tier map configured, no subset transport, or a degenerate
+    topology — ``parallel/tiering.py`` decides; this is pure cache).
+
+    Called once per bucketed sync by
+    :func:`~metrics_tpu.parallel.bucketing.host_sync_state_bucketed`; the
+    topology lookup itself is memoized on the live set, so the steady-state
+    cost is two dict probes.
+    """
+    from metrics_tpu.parallel import tiering
+
+    topo = tiering.active_topology()
+    if topo is None or sync_plan is None:
+        return None
+    schema_key = getattr(sync_plan, "schema_key", "")
+    key = (schema_key, topo.key)
+    with _PLANS_LOCK:
+        sched = _TIER_SCHEDULES.get(key)
+    transport = tiering.active_tier_transport()
+    if sched is not None and sched.transport is transport:
+        return sched
+    sched = TierSchedule(topo, transport, schema_key)
+    with _PLANS_LOCK:
+        if len(_TIER_SCHEDULES) >= _PLAN_CACHE_MAX:
+            _TIER_SCHEDULES.clear()
+        _TIER_SCHEDULES[key] = sched
+    if journal.ACTIVE:
+        journal.record(
+            "plan.tier",
+            schema_crc=zlib.crc32(schema_key.encode()) & 0x7FFFFFFF,
+            tiers=topo.n_tiers,
+            inter_participants=sched.inter_participants,
+            flat_participants=sched.flat_participants,
+        )
+    return sched
 
 
 # ---------------------------------------------------------------------------

@@ -74,6 +74,7 @@ EVENT_KINDS: Dict[str, str] = {
     "sync.launch": "a non-blocking round launched onto the background lane",
     "sync.resolve": "an overlapped round consumed, with staleness verdict",
     "sync.drain": "a round drained and discarded (the symmetric cancel)",
+    "sync.hop": "one hop of the tiered schedule (intra gather / inter exchange / broadcast)",
     # ---- health / fault tolerance (parallel/health.py) -------------------
     "health.failure": "a typed SyncError observed at a sync boundary",
     "health.watchdog": "a sync watchdog fired on a stuck collective",
@@ -104,6 +105,7 @@ EVENT_KINDS: Dict[str, str] = {
     "plan.hit": "an ExecutionPlan served from the unified plan cache",
     "plan.invalidate": "a state mutation invalidated an owner's plan binding",
     "plan.fused_step": "a whole-step fused program engaged (update+sync+compute)",
+    "plan.tier": "a tiered (two-level) schedule derived for a schema + topology",
 }
 
 #: Fast emission gate — ``True`` while the ring-buffer recorder is enabled

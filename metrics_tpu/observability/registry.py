@@ -66,6 +66,12 @@ DOMAIN_DEFAULTS: Dict[str, Dict[str, Any]] = {
         "gather_s": 0.0,
         "resolve_wait_s": 0.0,
         "overlap_saved_s": 0.0,
+        # tiered (two-level) schedule per-hop byte ledger: what this rank put
+        # on the fast (intra-tier) vs slow (inter-tier) wire, and how many
+        # slow-hop bytes the schedule avoided vs the flat world gather
+        "intra_tier_bytes": 0,
+        "inter_tier_bytes": 0,
+        "inter_tier_bytes_saved": 0,
     },
     "checkpoint": {
         "saves": 0,

@@ -65,6 +65,8 @@ def chrome_trace(events: Optional[Iterable[journal.Event]] = None) -> Dict[str, 
             base = min(base, e.ts - float(e.fields.get("dur_s", 0.0)))
         if e.kind == "sync.resolve":
             base = min(base, e.ts - float(e.fields.get("wait_s", 0.0)))
+        if e.kind == "sync.hop":
+            base = min(base, e.ts - float(e.fields.get("dur_s", 0.0)))
 
     def us(ts: float) -> float:
         return (ts - base) * 1e6
@@ -132,6 +134,18 @@ def chrome_trace(events: Optional[Iterable[journal.Event]] = None) -> Dict[str, 
                     "name": f"epoch {epoch}", "pid": ev.rank, "tid": SYNC_LANE,
                     "ts": us(ev.ts),
                 })
+        elif ev.kind == "sync.hop":
+            # the tiered schedule's two hop classes render as their own
+            # categories so the fast (intra-tier) and slow (inter-tier)
+            # wires are distinguishable (color + filter) in Perfetto
+            dur = float(ev.fields.get("dur_s", 0.0)) * 1e6
+            trace.append({
+                "ph": "X",
+                "name": f"{ev.label}-tier hop (tier {ev.fields.get('tier', -1)})",
+                "cat": f"sync-{ev.label}-tier",
+                "pid": ev.rank, "tid": SYNC_LANE,
+                "ts": us(ev.ts) - dur, "dur": dur, "args": args,
+            })
         elif ev.kind in ("sync.gather", "sync.plan", "sync.drain"):
             trace.append({
                 "ph": "i", "s": "t", "name": f"{ev.kind.partition('.')[2]} {ev.label}",

@@ -337,6 +337,8 @@ def launch_round(
     fused: Optional[bool] = None,
     sync_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     on_missing: str = "raise",
+    sync_precision: Optional[str] = None,
+    stats: Optional[Dict[str, Any]] = None,
 ) -> AsyncSyncRound:
     """Launch the health-checked host sync of ``snapshot`` on the background
     lane and return immediately.
@@ -349,7 +351,11 @@ def launch_round(
     :func:`~metrics_tpu.parallel.sync.host_sync_state` with this round's
     ``sync_epoch`` riding the header and ``on_missing`` threaded through —
     a quorum-degraded background round shrinks and retries over the
-    survivor set exactly like a blocking one.
+    survivor set exactly like a blocking one. ``sync_precision`` and
+    ``stats`` ride along unchanged, so an overlapped round launches the
+    same tiered (and optionally quantized-slow-hop) schedule the blocking
+    path would run, and its per-hop byte counters land in the same
+    ``sync``-domain dict.
     """
     round_ = AsyncSyncRound(
         snapshot,
@@ -379,6 +385,8 @@ def launch_round(
                 fused=fused,
                 sync_epoch=round_.epoch,
                 on_missing=on_missing,
+                sync_precision=sync_precision,
+                stats=stats,
             )
         finally:
             round_.gather_s = time.monotonic() - start

@@ -55,12 +55,14 @@ supported entry point; the ``METRICS_TPU_FUSED_SYNC=0`` env knob is the
 escape hatch back to the per-leaf path.
 """
 import os
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.observability import journal
 from metrics_tpu.parallel.health import (
     cat_family_names,
     cat_row_count,
@@ -261,28 +263,249 @@ def _assemble_cat(spec: LeafSpec, pieces: List[Any], local_value: Any, world: in
     return jnp.concatenate(pieces, axis=0)
 
 
+# ---------------------------------------------------------------------------
+# Two-level (tiered) collective schedule: reduce/concatenate within the tier
+# over the fast hop, ONE inter-tier exchange per bucket over the slow hop,
+# then an intra-tier broadcast. The topology is negotiated via the health
+# word's tier column (``parallel/tiering.py``), so by the time these helpers
+# run, every live rank has verified it derives the identical schedule.
+# ---------------------------------------------------------------------------
+
+
+def _bump_stats(stats: Optional[Dict[str, Any]], key: str, by: float) -> None:
+    if stats is not None:
+        stats[key] = stats.get(key, 0) + by
+
+
+def _tier_collective(transport: Any, x: Any, ranks: Any, timeout: Optional[float], what: str):
+    """One watchdog-guarded subset collective over ``ranks`` — the tiered
+    schedule's only primitive (same ``subset_allgather`` interface as the
+    quorum transport)."""
+    from metrics_tpu.parallel.health import call_with_sync_watchdog
+
+    arr = jnp.asarray(x)
+    return call_with_sync_watchdog(
+        lambda: jnp.asarray(transport.subset_allgather(arr, frozenset(ranks))),
+        timeout=timeout,
+        what=what,
+    )
+
+
+def _account_hop(
+    stats: Optional[Dict[str, Any]],
+    topo: Any,
+    hop: str,
+    nbytes: int,
+    participants: int,
+    dur_s: float,
+) -> None:
+    """Per-hop byte counters + ``sync.hop`` journal event. ``nbytes`` is
+    what THIS rank put on the wire for the hop (payload × (participants−1));
+    summed across ranks it is the fleet's traffic on that hop class."""
+    _bump_stats(stats, "intra_tier_bytes" if hop == "intra" else "inter_tier_bytes", int(nbytes))
+    if journal.ACTIVE:
+        journal.record(
+            "sync.hop",
+            label=hop,
+            tier=-1 if topo.my_tier is None else int(topo.my_tier),
+            participants=int(participants),
+            nbytes=int(nbytes),
+            dur_s=float(dur_s),
+        )
+
+
+def _tiered_allgather(
+    flat: Any,
+    topo: Any,
+    transport: Any,
+    timeout: Optional[float],
+    stats: Optional[Dict[str, Any]],
+    precision: Optional[str] = None,
+):
+    """Tiered replacement for one flat ``_process_allgather(flat)``.
+
+    Three hops — (1) intra-tier gather of every rank's payload, (2) leaders
+    exchange the concatenated (padded) tier blocks in ONE inter-tier
+    collective, (3) intra-tier broadcast of the exchanged blocks — then
+    every rank reassembles the ``[world, n]`` matrix in global rank order
+    via ``topo.assembly``. With ``precision=None`` the blocks move raw, so
+    the result is **bit-identical** to the flat gather (same rows, no
+    arithmetic); the slow hop simply carries ``n_tiers`` participants
+    instead of ``world``. ``precision`` (bf16/int8, float payloads only)
+    encodes ONLY the inter-tier wire — intra-tier hops always move full
+    precision.
+    """
+    from metrics_tpu.parallel import quantize
+
+    flat = jnp.asarray(flat)
+    n = int(flat.size)
+    item = np.dtype(flat.dtype).itemsize
+    members = topo.my_tier_ranks
+    k = len(members)
+
+    t0 = time.monotonic()
+    block = _tier_collective(transport, flat, members, timeout, "tier intra-gather")
+    _account_hop(stats, topo, "intra", n * item * (k - 1), k, time.monotonic() - t0)
+
+    width = topo.max_tier * n
+    if precision is not None and not jnp.issubdtype(np.dtype(flat.dtype), np.floating):
+        precision = None  # schema-static pass-through: identical on every rank
+    enc_n = quantize.encoded_size(width, flat.dtype, precision)
+    wire_dtype = (
+        flat.dtype
+        if precision is None
+        else (jnp.bfloat16 if precision == "bf16" else jnp.int8)
+    )
+    wire_item = np.dtype(wire_dtype).itemsize
+    if topo.is_leader:
+        payload = jnp.pad(jnp.asarray(block).reshape(-1), (0, width - k * n))
+        wire = quantize.encode(payload, precision)
+        t0 = time.monotonic()
+        inter = _tier_collective(transport, wire, topo.leaders, timeout, "tier inter-exchange")
+        _account_hop(
+            stats, topo, "inter",
+            enc_n * wire_item * (topo.n_tiers - 1), topo.n_tiers,
+            time.monotonic() - t0,
+        )
+        bc_payload = jnp.asarray(inter).reshape(-1)
+        actual_inter = enc_n * wire_item * (topo.n_tiers - 1)
+    else:
+        bc_payload = jnp.zeros((topo.n_tiers * enc_n,), wire_dtype)
+        actual_inter = 0
+    # what the flat world gather would have moved across tiers from this
+    # rank (payload to every rank outside its tier) minus what the tiered
+    # schedule actually moved — the headline "saved" counter
+    _bump_stats(
+        stats, "inter_tier_bytes_saved",
+        n * item * (len(topo.live) - k) - actual_inter,
+    )
+
+    t0 = time.monotonic()
+    bc = _tier_collective(transport, bc_payload, members, timeout, "tier broadcast")
+    _account_hop(
+        stats, topo, "intra",
+        int(bc_payload.size) * wire_item * (k - 1), k, time.monotonic() - t0,
+    )
+    rows = jnp.asarray(bc)[0].reshape(topo.n_tiers, enc_n)  # leader = min rank = row 0
+    decoded = quantize.decode(rows, width, flat.dtype, precision)  # [n_tiers, width]
+    return jnp.asarray(decoded).reshape(topo.n_tiers * topo.max_tier, n)[topo.assembly]
+
+
+def _tiered_quantized_reduce(
+    flat: Any,
+    fx: str,
+    topo: Any,
+    transport: Any,
+    timeout: Optional[float],
+    stats: Optional[Dict[str, Any]],
+    precision: str,
+):
+    """Quantized slow-hop reduce: full-precision reduce *within* the tier
+    first (so the fast hop loses nothing), encode the per-tier partial,
+    ONE inter-tier exchange of the encoded partials, decode, and combine
+    across tiers with error-compensated (Kahan) summation. Deterministic
+    end to end, so the result is bit-stable run-to-run."""
+    from metrics_tpu.parallel import quantize
+
+    flat = jnp.asarray(flat)
+    n = int(flat.size)
+    item = np.dtype(flat.dtype).itemsize
+    members = topo.my_tier_ranks
+    k = len(members)
+
+    t0 = time.monotonic()
+    block = jnp.asarray(
+        _tier_collective(transport, flat, members, timeout, "tier intra-gather")
+    )
+    _account_hop(stats, topo, "intra", n * item * (k - 1), k, time.monotonic() - t0)
+
+    if fx in ("sum", "mean"):
+        partial = jnp.sum(block.astype(jnp.float32), axis=0)
+    elif fx == "max":
+        partial = jnp.max(block, axis=0).astype(jnp.float32)
+    else:
+        partial = jnp.min(block, axis=0).astype(jnp.float32)
+    wire = quantize.encode(partial, precision)
+    enc_n = int(wire.size)
+    wire_item = np.dtype(wire.dtype).itemsize
+    if topo.is_leader:
+        t0 = time.monotonic()
+        inter = _tier_collective(transport, wire, topo.leaders, timeout, "tier inter-exchange")
+        _account_hop(
+            stats, topo, "inter",
+            enc_n * wire_item * (topo.n_tiers - 1), topo.n_tiers,
+            time.monotonic() - t0,
+        )
+        bc_payload = jnp.asarray(inter).reshape(-1)
+        actual_inter = enc_n * wire_item * (topo.n_tiers - 1)
+    else:
+        bc_payload = jnp.zeros((topo.n_tiers * enc_n,), wire.dtype)
+        actual_inter = 0
+    _bump_stats(
+        stats, "inter_tier_bytes_saved",
+        n * item * (len(topo.live) - k) - actual_inter,
+    )
+    t0 = time.monotonic()
+    bc = _tier_collective(transport, bc_payload, members, timeout, "tier broadcast")
+    _account_hop(
+        stats, topo, "intra",
+        int(bc_payload.size) * wire_item * (k - 1), k, time.monotonic() - t0,
+    )
+    rows = jnp.asarray(bc)[0].reshape(topo.n_tiers, enc_n)
+    partials = jnp.asarray(quantize.decode(rows, n, jnp.float32, precision))
+    if fx == "sum":
+        combined = quantize.kahan_sum(partials)
+    elif fx == "mean":
+        combined = quantize.kahan_sum(partials) / len(topo.live)
+    elif fx == "max":
+        combined = jnp.max(partials, axis=0)
+    else:
+        combined = jnp.min(partials, axis=0)
+    return combined.astype(flat.dtype)
+
+
 def host_sync_state_bucketed(
     state: Dict[str, Any],
     reductions: Dict[str, Any],
     words: Optional[np.ndarray] = None,
     timeout: Optional[float] = None,
     plan: Optional[SyncPlan] = None,
+    sync_precision: Optional[str] = None,
+    stats: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Fused payload sync of a whole (possibly collection-combined) state.
 
     Caller contract: the gathered health ``words`` have been *verified*
     (``host_sync_state`` does this) — the plan assumes schema equality,
-    non-empty cat states and clean CatBuffers across ranks. Issues exactly
+    non-empty cat states, clean CatBuffers, AND an agreed tier topology /
+    payload precision across ranks (the v5 header columns). Issues exactly
     one ``process_allgather`` per reduce bucket and per cat bucket (plus the
     per-leaf cost of callable-``fx`` fallbacks, and one length-vector gather
     only when the schema outgrows the header's ``CAT_LENGTH_SLOTS``).
+
+    When a tier map is configured (``parallel/tiering.py``) and a subset
+    transport is available, each bucket's flat world gather is replaced by
+    the two-level schedule (``core/plan.py``'s tier dimension): intra-tier
+    gather → ONE inter-tier exchange between tier leaders → intra-tier
+    broadcast. Full precision moves the raw blocks, so results stay
+    bit-identical to the flat gather; ``sync_precision`` ("bf16"/"int8",
+    explicit opt-in threaded from the Metric) encodes only the inter-tier
+    wire, with reduce buckets reduced within the tier first and recombined
+    across tiers via Kahan summation. ``stats`` (a ``sync``-domain counter
+    dict) receives the per-hop byte counters.
     """
+    from metrics_tpu.core import plan as plan_mod
+    from metrics_tpu.parallel.quantize import validate_sync_precision
     from metrics_tpu.parallel.resilience import effective_world
     from metrics_tpu.parallel.sync import _process_allgather, host_sync_leaf
 
     world = effective_world()
     if plan is None:
         plan = build_sync_plan(state, reductions)
+    precision = validate_sync_precision(sync_precision)
+    sched = plan_mod.tier_schedule_for(plan)
+    topo = sched.topology if sched is not None else None
+    transport = sched.transport if sched is not None else None
     out: Dict[str, Any] = {}
 
     # ---- dynamic input: per-rank row counts for every cat-family leaf ----
@@ -307,8 +530,16 @@ def host_sync_state_bucketed(
             for s in specs:
                 out[s.name] = jnp.asarray(state[s.name])
             continue
-        gathered = _process_allgather(flat, timeout=timeout)  # [world, total]
-        reduced = _REDUCERS[fx](gathered)
+        if topo is None:
+            gathered = _process_allgather(flat, timeout=timeout)  # [world, total]
+            reduced = _REDUCERS[fx](gathered)
+        elif precision is not None and jnp.issubdtype(np.dtype(flat.dtype), np.floating):
+            reduced = _tiered_quantized_reduce(
+                flat, fx, topo, transport, timeout, stats, precision
+            )
+        else:
+            gathered = _tiered_allgather(flat, topo, transport, timeout, stats)
+            reduced = _REDUCERS[fx](gathered)
         off = 0
         for s in specs:
             out[s.name] = reduced[off : off + s.item_size].reshape(s.item_shape)
@@ -336,9 +567,14 @@ def host_sync_state_bucketed(
             # nothing to move anywhere (every rank's rows are empty): skip the
             # collective symmetrically (max_total is identical on all ranks)
             gathered = jnp.zeros((world, 0), local_flat.dtype)
-        else:
+        elif topo is None:
             padded = jnp.pad(local_flat, (0, max_total - int(local_flat.size)))
             gathered = _process_allgather(padded, timeout=timeout)  # [world, max_total]
+        else:
+            padded = jnp.pad(local_flat, (0, max_total - int(local_flat.size)))
+            gathered = _tiered_allgather(
+                padded, topo, transport, timeout, stats, precision
+            )  # [world, max_total]; slow hop encoded iff precision + float dtype
         for j, s in enumerate(specs):
             pieces = []
             for r in range(world):

@@ -12,7 +12,7 @@ payload gather, every rank contributes one small int32 *health word* per
 metric in a *single* ``process_allgather``::
 
     [version, schema_hash, update_count, overflow, nonfinite, n_states,
-     sync_epoch, member_epoch, live_count,
+     sync_epoch, member_epoch, live_count, tier, precision,
      count_0 ... count_{COUNT_SLOTS-1},
      len_0 ... len_{CAT_LENGTH_SLOTS-1}]
 
@@ -49,6 +49,18 @@ metric in a *single* ``process_allgather``::
                     ``ceil(world/32)`` columns at fleet scale for no extra
                     safety, since epoch+count already diverge whenever the
                     sets do);
+- ``tier``          this rank's self-reported tier id under the configured
+                    tier map (``parallel/tiering.py``; ``-1`` = no map).
+                    Verified against the tier column every rank derives
+                    locally from the negotiated live set + its own map, so
+                    an asymmetric topology (ranks disagreeing who lives in
+                    which tier, or only some ranks configured for tiering)
+                    raises a typed ``StateDivergenceError`` on every rank
+                    before any tier-local payload collective is issued;
+- ``precision``     the slow-hop payload encoding this rank will apply
+                    (``parallel/quantize.py`` codes: 0 = full, 1 = bf16,
+                    2 = int8). Verified uniform across ranks, so no rank
+                    can silently mix encodings in one exchange;
 - ``count_j``       participation count of the j-th state (sorted by name):
                     CatBuffer fill count, number of appended batches for
                     list states (a rank that appended one zero-row batch
@@ -143,8 +155,11 @@ T = TypeVar("T")
 #: shape gathers. v3: the ``sync_epoch`` column (overlapped-round alignment
 #: for ``parallel/async_sync.py``). v4: the ``member_epoch`` and
 #: ``live_count`` columns (quorum membership, ``parallel/resilience.py``).
-#: Older peers are caught by the width/version checks.
-HEALTH_PROTOCOL_VERSION = 4
+#: v5: the ``tier`` and ``precision`` columns (two-level hierarchical sync
+#: topology + slow-hop payload encoding, ``parallel/tiering.py`` /
+#: ``parallel/quantize.py``). Older peers are caught by the width/version
+#: checks.
+HEALTH_PROTOCOL_VERSION = 5
 
 #: Reserved state name for the ``check_finite`` poison flag (see
 #: ``Metric.enable_check_finite``): an int32 scalar with ``dist_reduce_fx="sum"``
@@ -170,7 +185,9 @@ _F_NSTATES = 5
 _F_EPOCH = 6
 _F_MEMBER_EPOCH = 7
 _F_LIVE = 8
-_F_FIXED = 9
+_F_TIER = 9
+_F_PRECISION = 10
+_F_FIXED = 11
 
 #: Fixed number of per-state count slots; unused slots hold the -1 sentinel.
 COUNT_SLOTS = 16
@@ -417,6 +434,7 @@ def build_health_word(
     reductions: Dict[str, Any],
     update_count: int = 0,
     sync_epoch: int = 0,
+    sync_precision: Any = None,
 ) -> np.ndarray:
     """This rank's int32 health word for one metric's state dict.
 
@@ -451,7 +469,9 @@ def build_health_word(
     cat_names = [n for n in names if _is_cat_family(kinds[n], reductions.get(n))]
     for j, name in enumerate(cat_names[:CAT_LENGTH_SLOTS]):
         length_slots[j] = cat_row_count(state[name], kinds[name])
+    from metrics_tpu.parallel.quantize import precision_code, validate_sync_precision
     from metrics_tpu.parallel.resilience import live_count, membership_epoch
+    from metrics_tpu.parallel.tiering import my_tier_id
 
     word = [
         HEALTH_PROTOCOL_VERSION,
@@ -463,6 +483,8 @@ def build_health_word(
         int(sync_epoch),
         int(membership_epoch()),
         int(live_count()),
+        int(my_tier_id()),
+        precision_code(validate_sync_precision(sync_precision)),
     ] + slots + length_slots
     return np.asarray(word, dtype=np.int32)
 
@@ -533,6 +555,46 @@ def verify_health_words(
             "differ — ranks disagree which quorum membership this collective "
             "runs over (a rank missed a shrink or readmit transition). All "
             "processes raised together."
+        )
+
+    # 0c) tier-topology skew: every rank derives the expected tier column
+    #     from (negotiated live set, its own tier map) and compares it to
+    #     what the ranks self-reported. Asymmetric maps — a rank with a
+    #     different METRICS_TPU_TIER_SIZE, a different tier_of callable, or
+    #     no map at all while peers have one — cannot produce a column that
+    #     matches every rank's expectation, so the tier-local collective
+    #     schedule is refused loudly and symmetrically instead of pairing a
+    #     leader exchange against a flat gather.
+    from metrics_tpu.parallel.tiering import expected_tier_column
+
+    tiers = words[:, _F_TIER]
+    expected_tiers = expected_tier_column(world)
+    tier_ok = (
+        (tiers == -1).all()
+        if expected_tiers is None
+        else expected_tiers.shape[0] == world and (tiers == expected_tiers).all()
+    )
+    if not tier_ok:
+        raise StateDivergenceError(
+            f"tier-topology skew for {metric_name}: gathered tier column "
+            f"{tiers.tolist()} does not match this rank's expected "
+            f"{'flat world (all -1)' if expected_tiers is None else expected_tiers.tolist()}"
+            " — ranks disagree on the tier map (asymmetric "
+            "METRICS_TPU_TIER_SIZE / set_tier_map, or tiering configured on "
+            "only some ranks). All processes raised together."
+        )
+
+    # 0d) payload-precision skew: the slow-hop encoding must be uniform —
+    #     a bf16/int8 rank exchanging with a full-precision peer would
+    #     decode garbage without any shape error to catch it
+    precisions = words[:, _F_PRECISION]
+    if not (precisions == precisions[0]).all():
+        raise StateDivergenceError(
+            f"sync-precision skew for {metric_name}: per-rank payload "
+            f"precision codes {precisions.tolist()} differ (0=full, 1=bf16, "
+            "2=int8) — ranks would mix slow-hop encodings in one exchange. "
+            "Set the same `sync_precision=` on every rank. All processes "
+            "raised together."
         )
 
     # 0) state-count divergence: ranks don't even agree how many states

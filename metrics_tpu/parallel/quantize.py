@@ -1,0 +1,154 @@
+"""Slow-hop payload codecs for the tiered sync schedule.
+
+EQuARX (PAPERS.md) shows where quantized collectives pay: the *inter-node*
+hop, where bytes are the bottleneck and the intra-node reduction can stay
+full precision. This module provides the two opt-in encodings the tiered
+bucketed sync (``parallel/bucketing.py``) applies to the ONE inter-tier
+exchange per bucket — never to the fast intra-tier hops, and never unless
+the user set ``sync_precision=`` on the Metric/MetricCollection:
+
+- ``"bf16"`` — truncate float payloads to ``bfloat16`` (same exponent range
+  as float32, 8-bit mantissa): 2× fewer slow-hop bytes, ~3 decimal digits;
+- ``"int8"`` — block-scaled int8 (:data:`BLOCK`-element blocks, one float32
+  scale per block, ``scale = maxabs/127``): 4× fewer bytes than float32
+  payloads (scales amortize to 4/``BLOCK`` bytes/element), with the scale
+  vector bitcast into the same int8 payload so the exchange stays ONE
+  collective per bucket.
+
+Both codecs are **deterministic** (round-half-away-from-zero via
+``jnp.round``, scales derived from the data, no RNG), so a quantized sync
+is bit-stable run-to-run — the property the equivalence suite asserts.
+Non-float payloads (int cat states, counters) pass through unencoded: their
+bucket dtype is schema-static, so the pass-through decision is identical on
+every rank. Cross-tier *reduce* combination uses error-compensated (Kahan)
+summation (:func:`kahan_sum`) so the decode error of ``n_tiers`` partial
+sums does not additionally compound through naive accumulation.
+
+The precision choice rides the health word's precision column (protocol v5,
+``parallel/health.py``): a rank syncing ``"int8"`` while a peer syncs full
+precision raises a typed ``StateDivergenceError`` on every rank before any
+payload moves — no rank can silently mix encodings.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "BLOCK",
+    "PRECISION_CODES",
+    "SYNC_PRECISIONS",
+    "decode",
+    "encode",
+    "encoded_size",
+    "kahan_sum",
+    "precision_code",
+    "validate_sync_precision",
+]
+
+#: Accepted ``sync_precision=`` values (``None``/"full" = no quantization).
+SYNC_PRECISIONS = (None, "full", "bf16", "int8")
+
+#: Health-word precision-column codes (0 must stay "full": a pre-v5 fleet
+#: that never writes the column is equivalent to full precision).
+PRECISION_CODES = {None: 0, "full": 0, "bf16": 1, "int8": 2}
+
+#: int8 block size: one float32 scale per BLOCK elements (16 B overhead
+#: per 256 B of payload at int8 — 1.6%).
+BLOCK = 256
+
+
+def validate_sync_precision(precision: Any) -> Optional[str]:
+    """Normalize/validate the knob: returns ``None`` (full precision) or
+    ``"bf16"``/``"int8"``."""
+    if precision in (None, "full"):
+        return None
+    if precision in ("bf16", "int8"):
+        return precision
+    from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+    raise MetricsTPUUserError(
+        f"`sync_precision` must be one of {SYNC_PRECISIONS}, got {precision!r}"
+    )
+
+
+def precision_code(precision: Optional[str]) -> int:
+    """The health-word column value for a (normalized) precision."""
+    return PRECISION_CODES[precision]
+
+
+def _quantizable(dtype: Any) -> bool:
+    return bool(jnp.issubdtype(np.dtype(dtype), np.floating))
+
+
+def encoded_size(n: int, dtype: Any, precision: Optional[str]) -> int:
+    """Encoded element count for an ``n``-element payload — identical on
+    every rank for equal ``n`` (the collective well-formedness requirement).
+    """
+    if precision is None or not _quantizable(dtype):
+        return int(n)
+    if precision == "bf16":
+        return int(n)
+    nb = -(-int(n) // BLOCK)  # ceil
+    return nb * BLOCK + nb * 4  # int8 payload + bitcast float32 scales
+
+
+def encode(flat: Any, precision: Optional[str]) -> Any:
+    """Encode a flat 1-D payload for the slow hop.
+
+    Returns the array to put on the wire. Full precision and non-float
+    dtypes pass through unchanged (schema-static decision, rank-symmetric).
+    """
+    flat = jnp.asarray(flat)
+    if precision is None or not _quantizable(flat.dtype):
+        return flat
+    if precision == "bf16":
+        return flat.astype(jnp.bfloat16)
+    # int8 block-scaled: pad to whole blocks (zeros quantize exactly),
+    # per-block scale = maxabs/127, scales bitcast into the int8 payload
+    n = int(flat.size)
+    nb = -(-n // BLOCK)
+    padded = jnp.pad(flat.astype(jnp.float32), (0, nb * BLOCK - n)).reshape(nb, BLOCK)
+    maxabs = jnp.max(jnp.abs(padded), axis=1)
+    scale = jnp.where(maxabs > 0, maxabs / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(padded / scale[:, None]), -127, 127).astype(jnp.int8)
+    scale_bytes = jax.lax.bitcast_convert_type(scale, jnp.int8).reshape(-1)
+    return jnp.concatenate([q.reshape(-1), scale_bytes])
+
+
+def decode(wire: Any, n: int, dtype: Any, precision: Optional[str]) -> Any:
+    """Invert :func:`encode` back to ``n`` elements of ``dtype``.
+
+    ``wire`` may carry a leading batch dimension (the gathered
+    ``[participants, encoded]`` matrix) — decoding maps over it.
+    """
+    wire = jnp.asarray(wire)
+    if precision is None or not _quantizable(dtype):
+        return wire
+    if wire.ndim == 2:
+        return jnp.stack([decode(row, n, dtype, precision) for row in wire])
+    if precision == "bf16":
+        return wire[:n].astype(dtype)
+    nb = -(-int(n) // BLOCK)
+    q = wire[: nb * BLOCK].astype(jnp.float32).reshape(nb, BLOCK)
+    scale = jax.lax.bitcast_convert_type(
+        wire[nb * BLOCK : nb * BLOCK + nb * 4].reshape(nb, 4), jnp.float32
+    )
+    return (q * scale[:, None]).reshape(-1)[:n].astype(dtype)
+
+
+def kahan_sum(rows: Any) -> Any:
+    """Error-compensated (Kahan) sum over axis 0 of ``[k, n]`` — the
+    cross-tier combine for quantized reduce partials. ``k`` = number of
+    tiers (small), so the eager python loop costs nothing and keeps the
+    summation order deterministic (tier order) on every rank."""
+    rows = jnp.asarray(rows, jnp.float32)
+    total = jnp.zeros(rows.shape[1:], jnp.float32)
+    comp = jnp.zeros_like(total)
+    for i in range(rows.shape[0]):
+        y = rows[i] - comp
+        t = total + y
+        comp = (t - total) - y
+        total = t
+    return total

@@ -390,6 +390,8 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
     fused: Optional[bool] = None,
     sync_epoch: int = 0,
     on_missing: str = "raise",
+    sync_precision: Optional[str] = None,
+    stats: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Host-path sync of a whole metric-state dict across processes.
 
@@ -417,6 +419,14 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
     equal across ranks, so a rank resolving an in-flight background round
     can never pair its collectives with a peer's foreground sync
     (``parallel/async_sync.py`` sets it per round).
+
+    ``sync_precision`` (``"bf16"``/``"int8"``, explicit opt-in threaded from
+    the Metric/MetricCollection constructor) engages the quantized slow hop
+    of the tiered schedule (``parallel/bucketing.py``); the value rides the
+    health word's precision column (protocol v5) so a mixed-precision fleet
+    raises symmetrically before any payload moves. ``stats`` is the owner's
+    ``sync``-domain telemetry dict — the bucketed engine bumps the per-hop
+    byte counters into it.
 
     ``on_missing`` decides what a *missing-rank* failure (watchdog timeout,
     dead transport, divergent header) means: ``"raise"`` (default, the
@@ -473,7 +483,8 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
             from metrics_tpu.parallel.health import build_health_word, verify_health_words
 
             word = build_health_word(
-                state, reductions, update_count=update_count, sync_epoch=sync_epoch
+                state, reductions, update_count=update_count, sync_epoch=sync_epoch,
+                sync_precision=sync_precision,
             )
             words = np.asarray(_process_allgather(jnp.asarray(word), timeout=timeout))
             verify_health_words(
@@ -487,7 +498,10 @@ def host_sync_state(  # metricslint: disable=data-dependent-collective
             from metrics_tpu.parallel.bucketing import fused_sync_enabled, host_sync_state_bucketed
 
             if fused_sync_enabled() if fused is None else fused:
-                return host_sync_state_bucketed(state, reductions, words=words, timeout=timeout)
+                return host_sync_state_bucketed(
+                    state, reductions, words=words, timeout=timeout,
+                    sync_precision=sync_precision, stats=stats,
+                )
         return {
             name: host_sync_leaf(value, reductions.get(name), precheck=precheck, timeout=timeout)
             for name, value in state.items()

@@ -1,0 +1,331 @@
+"""Tier topology for the two-level (hierarchical) host-sync schedule.
+
+A real fleet is not flat: ranks inside one slice/host talk over fast ICI,
+ranks across slices over slow DCN — FastUSP-style multi-level collaborative
+collectives (PAPERS.md) win exactly because the slow hop carries the fewest
+possible participants and bytes. This module owns the *topology* side of
+that schedule:
+
+- **Configuration.** A tier map assigns every rank a tier id. Two seams:
+  the ``METRICS_TPU_TIER_SIZE`` env knob (ranks ``[k*size, (k+1)*size)``
+  share tier ``k`` — matching ``tests/helpers/fake_world.FaultProfile``'s
+  latency model) and the explicit :func:`set_tier_map` override (an int
+  tier size or a ``rank -> tier`` callable) for irregular fleets. No map
+  configured = the flat world, and the sync path is bit-identical to the
+  untiered code with zero extra collectives.
+- **Negotiation.** The topology is a *pure function* of the negotiated
+  live-rank set (``parallel/resilience.py``) and the configured map, so
+  every rank derives the identical :class:`TierTopology` with no extra
+  collectives — including in the same epoch as a quorum shrink, where the
+  survivor set changed under it. The health word (protocol v5,
+  ``parallel/health.py``) carries each rank's self-reported tier id and
+  payload-precision code; :func:`expected_tier_column` is what the
+  verifier compares the gathered column against, so an asymmetric tier map
+  (ranks disagreeing who lives in which tier) or a mixed-precision fleet
+  raises a typed ``StateDivergenceError`` on every rank *before* any
+  payload collective.
+- **Transport.** Tiered hops are subset collectives. The seam is the same
+  ``subset_allgather(x, ranks)`` interface quorum mode rides
+  (``resilience.set_quorum_transport``): :func:`active_tier_transport`
+  prefers an explicitly installed tier transport and falls back to the
+  quorum transport, so a fleet (or a simulated world) wired for quorum
+  sync is tier-capable for free. A tier map configured with *no* transport
+  warns once and keeps the flat path — never a silent behavior change.
+
+The schedule itself (reduce-within-tier → one inter-tier exchange per
+bucket → intra-tier broadcast) lives with the bucketed execution engine
+(``parallel/bucketing.py``); the per-schema schedule cache lives with the
+unified plan store (``core/plan.py``).
+"""
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "TIER_SIZE_ENV",
+    "TierTopology",
+    "active_tier_transport",
+    "active_topology",
+    "expected_tier_column",
+    "my_tier_id",
+    "reset_tiering",
+    "set_tier_map",
+    "set_tier_transport",
+    "tier_of_rank",
+    "tier_topology",
+    "tiering_configured",
+]
+
+#: Env knob: int tier size — ranks ``[k*size, (k+1)*size)`` share tier ``k``.
+TIER_SIZE_ENV = "METRICS_TPU_TIER_SIZE"
+
+_LOCK = threading.Lock()
+_TIER_MAP: Optional[Callable[[int], int]] = None
+_TIER_MAP_TOKEN: Any = None
+_TIER_TRANSPORT: Optional[Any] = None
+_TOPOLOGY_CACHE: Dict[Any, "TierTopology"] = {}
+
+
+def _current_rank() -> int:
+    """This process's global rank — the seam simulated thread-per-rank
+    worlds monkeypatch to the calling thread's identity (production: one
+    rank per process, ``jax.process_index()``)."""
+    import jax
+
+    return jax.process_index()
+
+
+def set_tier_map(tier_map: Any) -> None:
+    """Install (or clear, with ``None``) the explicit tier map.
+
+    ``tier_map`` is an int tier size or a ``rank -> tier id`` callable.
+    The explicit map wins over the :data:`TIER_SIZE_ENV` knob. Must be
+    installed identically on every rank — the health word's tier column
+    verifies exactly that and raises symmetrically when it is not.
+    """
+    global _TIER_MAP, _TIER_MAP_TOKEN
+    with _LOCK:
+        if tier_map is None:
+            _TIER_MAP, _TIER_MAP_TOKEN = None, None
+        elif callable(tier_map):
+            _TIER_MAP, _TIER_MAP_TOKEN = tier_map, ("fn", id(tier_map))
+        else:
+            size = int(tier_map)
+            if size <= 0:
+                from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+                raise MetricsTPUUserError(
+                    f"tier size must be a positive int, got {tier_map!r}"
+                )
+            _TIER_MAP = lambda rank, _s=size: rank // _s
+            _TIER_MAP_TOKEN = ("size", size)
+        _TOPOLOGY_CACHE.clear()
+
+
+def set_tier_transport(transport: Optional[Any]) -> None:
+    """Install (or clear) the subset-collective transport tiered hops ride
+    on — same ``subset_allgather(x, ranks)`` interface as the quorum
+    transport, which :func:`active_tier_transport` falls back to."""
+    global _TIER_TRANSPORT
+    with _LOCK:
+        _TIER_TRANSPORT = transport
+
+
+def reset_tiering() -> None:
+    """Clear map, transport and topology cache (tests)."""
+    global _TIER_MAP, _TIER_MAP_TOKEN, _TIER_TRANSPORT
+    with _LOCK:
+        _TIER_MAP, _TIER_MAP_TOKEN, _TIER_TRANSPORT = None, None, None
+        _TOPOLOGY_CACHE.clear()
+
+
+def _configured_map() -> Tuple[Optional[Callable[[int], int]], Any]:
+    """(tier_of callable, cache token) — explicit map, else env size, else
+    ``(None, None)`` (flat world)."""
+    with _LOCK:
+        if _TIER_MAP is not None:
+            return _TIER_MAP, _TIER_MAP_TOKEN
+    raw = os.environ.get(TIER_SIZE_ENV, "").strip()
+    if not raw:
+        return None, None
+    try:
+        size = int(raw)
+    except ValueError:
+        from metrics_tpu.observability.diagnostics import warn_once
+
+        warn_once(
+            "tier-size-invalid",
+            f"{TIER_SIZE_ENV}={raw!r} is not an int — tiered sync disabled, "
+            "falling back to the flat world gather.",
+        )
+        return None, None
+    if size <= 0:
+        return None, None
+    return (lambda rank, _s=size: rank // _s), ("size", size)
+
+
+def tiering_configured() -> bool:
+    """Is any tier map (explicit or env) configured on this rank?"""
+    return _configured_map()[0] is not None
+
+
+def tier_of_rank(rank: int) -> int:
+    """Tier id of ``rank`` under the configured map; ``-1`` when no map is
+    configured (the flat world). The value every rank self-reports in its
+    health word's tier column — negotiated, not trusted: the verifier
+    compares the gathered column against :func:`expected_tier_column`."""
+    fn, _ = _configured_map()
+    return -1 if fn is None else int(fn(int(rank)))
+
+
+def my_tier_id() -> int:
+    """This rank's tier id (``-1`` unconfigured) — the health-word column."""
+    return tier_of_rank(_current_rank())
+
+
+class TierTopology:
+    """The negotiated two-level layout over one live-rank set.
+
+    Pure data, derived identically on every rank from ``(live, tier map)``:
+
+    - ``live`` — sorted live ranks (the gather's global row order);
+    - ``tiers`` — ``tier id -> sorted member ranks`` (tier ids sorted);
+    - ``leaders`` — one leader (min rank) per tier, in tier order: the
+      inter-tier exchange's participant set;
+    - ``assembly`` — for each live rank (in global sorted order) the row
+      index ``tier_pos * max_tier + member_pos`` into the concatenated
+      padded tier blocks, so every rank reconstructs the exact ``[world,
+      n]`` matrix the flat gather would have produced — bit-identical,
+      whatever the tier map's rank interleaving;
+    - per-rank views (``my_tier_ranks`` / ``is_leader`` / ``leader_pos``)
+      for the executing rank.
+
+    ``degenerate`` (one tier, or one rank per tier) means the schedule
+    cannot beat the flat gather; callers keep the flat path.
+    """
+
+    __slots__ = (
+        "key",
+        "live",
+        "tiers",
+        "leaders",
+        "max_tier",
+        "assembly",
+        "rank",
+        "my_tier",
+        "my_tier_ranks",
+        "is_leader",
+        "leader_pos",
+        "tier_pos",
+        "expected_tiers",
+    )
+
+    def __init__(self, live: Tuple[int, ...], tier_of: Callable[[int], int], rank: int, key: Any) -> None:
+        self.key = key
+        self.live = tuple(sorted(int(r) for r in live))
+        members: Dict[int, list] = {}
+        for r in self.live:
+            members.setdefault(int(tier_of(r)), []).append(r)
+        self.tiers = {tid: tuple(members[tid]) for tid in sorted(members)}
+        self.leaders = tuple(ranks[0] for ranks in self.tiers.values())
+        self.max_tier = max(len(ranks) for ranks in self.tiers.values())
+        tier_order = {tid: i for i, tid in enumerate(self.tiers)}
+        self.expected_tiers = np.asarray([tier_of(r) for r in self.live], np.int32)
+        pos: Dict[int, int] = {}
+        for tid, ranks in self.tiers.items():
+            for j, r in enumerate(ranks):
+                pos[r] = tier_order[tid] * self.max_tier + j
+        self.assembly = np.asarray([pos[r] for r in self.live], np.int64)
+        self.rank = int(rank)
+        my_tid = int(tier_of(self.rank)) if self.rank in pos else None
+        self.my_tier = my_tid
+        self.my_tier_ranks = self.tiers.get(my_tid, ())
+        self.is_leader = bool(self.my_tier_ranks) and self.my_tier_ranks[0] == self.rank
+        self.leader_pos = 0  # the leader is the min rank = row 0 of its tier block
+        self.tier_pos = tier_order.get(my_tid, -1)
+
+    @property
+    def n_tiers(self) -> int:
+        return len(self.tiers)
+
+    @property
+    def degenerate(self) -> bool:
+        """One tier (pure-fast-hop world) or one rank per tier (the tiered
+        schedule degenerates to the flat gather plus overhead)."""
+        return self.n_tiers <= 1 or self.max_tier <= 1
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"TierTopology(n_tiers={self.n_tiers}, live={len(self.live)}, "
+            f"rank={self.rank}, tier={self.my_tier}, leader={self.is_leader})"
+        )
+
+
+def tier_topology(live: Any, rank: int, tier_of: Optional[Callable[[int], int]] = None) -> TierTopology:
+    """Derive (memoized) the :class:`TierTopology` for one live set.
+
+    Keyed on ``(live tuple, map token, rank)`` so a quorum shrink — which
+    changes ``live`` — re-derives the topology in the *same* membership
+    epoch with zero extra collectives: survivors agree on ``live`` by
+    negotiation and on the map by configuration, hence on the topology.
+    """
+    token: Any = None
+    if tier_of is None:
+        tier_of, token = _configured_map()
+        if tier_of is None:
+            from metrics_tpu.utils.exceptions import MetricsTPUUserError
+
+            raise MetricsTPUUserError(
+                "tier_topology: no tier map configured (set_tier_map or "
+                f"{TIER_SIZE_ENV})"
+            )
+    else:
+        token = ("fn", id(tier_of))
+    live_t = tuple(sorted(int(r) for r in live))
+    key = (live_t, token, int(rank))
+    with _LOCK:
+        topo = _TOPOLOGY_CACHE.get(key)
+        if topo is None:
+            topo = TierTopology(live_t, tier_of, int(rank), key)
+            if len(_TOPOLOGY_CACHE) > 64:  # membership changes are rare
+                _TOPOLOGY_CACHE.clear()
+            _TOPOLOGY_CACHE[key] = topo
+        return topo
+
+
+def expected_tier_column(world: int) -> Optional[np.ndarray]:
+    """The tier-id column this rank EXPECTS every live rank to report —
+    ``None`` when no map is configured (peers must then report ``-1``).
+    Row order matches the gathered health words (sorted live ranks). Pads
+    with the configured map when the gathered world disagrees with the
+    local live view (the membership-skew check fires first anyway)."""
+    fn, _ = _configured_map()
+    if fn is None:
+        return None
+    from metrics_tpu.parallel.resilience import live_ranks
+
+    live = tuple(sorted(live_ranks()))
+    if len(live) != world:
+        live = tuple(range(world))
+    return np.asarray([int(fn(r)) for r in live], np.int32)
+
+
+def active_tier_transport() -> Optional[Any]:
+    """The subset-collective transport tiered hops run over: the explicitly
+    installed one, else the quorum transport (``parallel/resilience.py``),
+    else ``None`` (tiered sync stays off)."""
+    with _LOCK:
+        if _TIER_TRANSPORT is not None:
+            return _TIER_TRANSPORT
+    from metrics_tpu.parallel import resilience
+
+    return getattr(resilience, "_TRANSPORT", None)
+
+
+def active_topology() -> Optional[TierTopology]:
+    """The topology the NEXT bucketed sync should schedule over, or ``None``
+    for the flat path: no map configured, no subset transport installed
+    (warned once — never a silent change), or a degenerate layout (single
+    tier / one rank per tier, where flat is already optimal).
+    """
+    fn, token = _configured_map()
+    if fn is None:
+        return None
+    if active_tier_transport() is None:
+        from metrics_tpu.observability.diagnostics import warn_once
+
+        warn_once(
+            "tier-no-transport",
+            "a tier map is configured but no subset-collective transport is "
+            "installed (tiering.set_tier_transport / "
+            "resilience.set_quorum_transport) — the two-level schedule "
+            "cannot issue tier-local collectives, so syncs keep the flat "
+            "world gather.",
+        )
+        return None
+    from metrics_tpu.parallel.resilience import live_ranks
+
+    topo = tier_topology(live_ranks(), _current_rank(), None)
+    return None if topo.degenerate else topo

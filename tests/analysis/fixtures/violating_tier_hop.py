@@ -1,0 +1,35 @@
+"""metricslint fixture: ad-hoc rank-gated tier hops.
+
+The tiered sync schedule (``parallel/tiering.py`` + ``parallel/bucketing.py``)
+is legal because its topology is NEGOTIATED: a pure function of the agreed
+live set and a config-identical tier map, re-verified by the health word's
+tier column before any payload collective — so the schedule pass treats the
+tiering readers as taint-washing symmetric calls. This fixture is the
+anti-pattern: hand-rolled "hierarchical" hops gated directly on
+``process_index()`` arithmetic, which no header ever verifies. The CI gate
+asserts the CLI exits NONZERO on this file.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def _process_allgather(x, timeout=None):  # stand-in collective
+    return jnp.asarray(x)[None]
+
+
+def adhoc_leader_exchange(x, tier_size):
+    """finding: rank-dependent-collective — only self-appointed 'leaders'
+    (a raw process_index modulus, never negotiated or header-verified)
+    emit the inter-tier gather."""
+    if jax.process_index() % tier_size == 0:
+        return _process_allgather(x)
+    return x
+
+
+def adhoc_tier_branch(x, tier_size):
+    """finding: rank-dependent-collective — ranks in tier 0 run a different
+    collective sequence than every other tier."""
+    tier = jax.process_index() // tier_size
+    if tier == 0:
+        return _process_allgather(_process_allgather(x))
+    return _process_allgather(x)

@@ -57,6 +57,12 @@ class LockstepWorld:
         self._rank = threading.local()
         self._executors: Dict[int, SerialExecutor] = {}
         self._executors_lock = threading.Lock()
+        # subset-collective rendezvous (the tier-transport seam): keyed by
+        # participant set + per-(rank, set) round index, so different tiers
+        # gather concurrently without blocking each other on one barrier
+        self._sub_cv = threading.Condition()
+        self._sub_counters: Dict[Any, int] = {}
+        self._sub_entries: Dict[Any, Dict[str, Any]] = {}
 
     def executor_for_current_rank(self) -> SerialExecutor:
         """Per-rank single-worker executor whose thread carries this rank's
@@ -111,6 +117,51 @@ class LockstepWorld:
             pass
         return out
 
+    def subset_allgather(self, x: Any, ranks: Any, timeout_s: float = 60.0):
+        """Rendezvous collective over an arbitrary participant subset — the
+        ``tiering.set_tier_transport`` / quorum-transport seam. Concurrent
+        rounds over DIFFERENT subsets (each tier's intra-hop) proceed
+        independently; rounds over the same subset are ordered by a
+        per-(rank, subset) counter exactly like :class:`FleetWorld`'s
+        cv-keyed gathers. Counts one collective round in ``calls`` per
+        completed rendezvous (not per rank)."""
+        rank = self._rank.value
+        ranks = frozenset(int(r) for r in ranks)
+        if rank not in ranks:
+            raise AssertionError(
+                f"rank {rank} issued a subset collective over {sorted(ranks)} "
+                "it does not belong to"
+            )
+        with self._sub_cv:
+            ckey = (rank, ranks)
+            round_idx = self._sub_counters.get(ckey, 0)
+            self._sub_counters[ckey] = round_idx + 1
+            entry_key = (ranks, round_idx)
+            entry = self._sub_entries.setdefault(
+                entry_key, {"vals": {}, "result": None, "readers": 0}
+            )
+            entry["vals"][rank] = np.asarray(x).copy()
+            if len(entry["vals"]) == len(ranks):
+                entry["result"] = np.stack(
+                    [entry["vals"][r] for r in sorted(ranks)]
+                )
+                self.calls += 1
+                self._sub_cv.notify_all()
+            deadline = time.monotonic() + timeout_s
+            while entry["result"] is None:
+                if time.monotonic() > deadline:
+                    raise SyncTimeoutError(
+                        f"[LockstepWorld] subset gather over {sorted(ranks)} "
+                        f"did not complete within {timeout_s:.1f}s"
+                    )
+                self._sub_cv.wait(0.02)
+            out = jnp.asarray(entry["result"])
+            # last reader retires the round (keeps long runs memory-flat)
+            entry["readers"] += 1
+            if entry["readers"] == len(ranks):
+                self._sub_entries.pop(entry_key, None)
+            return out
+
     def run(self, fn: Callable[[int], Any], timeout: float = 120.0) -> List[Any]:
         results: List[Any] = [None] * self.world
         errors: List[Optional[BaseException]] = [None] * self.world
@@ -162,8 +213,11 @@ class FaultProfile:
     replays bit-identically across runs and platforms — no RNG state.
 
     - ``tier_size``: ranks ``[k*tier_size, (k+1)*tier_size)`` share a tier;
-      a gather whose participant set spans tiers pays ``inter_tier_latency_s``
-      per rank instead of ``intra_tier_latency_s``.
+      a gather over ``k`` participants pays ``(k-1)`` ring hops of
+      ``inter_tier_latency_s`` when the participant set spans tiers, of
+      ``intra_tier_latency_s`` otherwise — so a leaders-only inter-tier
+      exchange is cheaper than a full-world gather in wall-clock, not just
+      in bytes.
     - ``preempt_at``: rank -> step at which that rank is permanently
       preempted (raises :class:`RankPreempted` from ``begin_round``).
     - ``preempt_hazard``: per-(rank, step) permanent-preemption probability.
@@ -307,12 +361,18 @@ class FleetWorld(LockstepWorld):
         delay = 0.0
         if rank in profile.straggler_ranks:
             delay += profile.straggler_delay_s
+        # ring-allgather wire model: a collective over k participants takes
+        # (k-1) rounds of its slowest hop — the inter-tier wire whenever the
+        # participant set spans tiers, the intra-tier wire otherwise. This is
+        # what makes the tiered schedule's smaller inter-tier participant set
+        # (leaders only) a WALL-CLOCK win, not just a byte-count win.
         tiers = {r // profile.tier_size for r in expected}
-        delay += (
+        hop = (
             profile.inter_tier_latency_s
             if len(tiers) > 1
             else profile.intra_tier_latency_s
         )
+        delay += hop * (len(expected) - 1)
         if profile.jitter_s > 0.0:
             token = f"{profile.seed}:{rank}:{self._steps.get(rank, -1)}:{tag}"
             delay += profile.jitter_s * (zlib.crc32(token.encode()) / 2**32)
@@ -505,13 +565,19 @@ class FleetWorld(LockstepWorld):
         from metrics_tpu.parallel import async_sync as async_mod
         from metrics_tpu.parallel import resilience
         from metrics_tpu.parallel import sync as sync_mod
+        from metrics_tpu.parallel import tiering
 
         resilience.reset_resilience()
+        tiering.reset_tiering()
         monkeypatch.setattr(jax, "process_count", lambda: self.world)
         monkeypatch.setattr(sync_mod, "_raw_process_allgather", self.allgather)
         monkeypatch.setattr(async_mod, "_get_executor", self.executor_for_current_rank)
         monkeypatch.setattr(async_mod, "_current_domain", self.rank_domain)
         monkeypatch.setattr(resilience, "_current_domain", self.rank_domain)
+        # tier hops run over this world's subset collectives for free (the
+        # quorum-transport fallback in ``tiering.active_tier_transport``);
+        # the rank seam makes each fake rank derive ITS OWN topology view
+        monkeypatch.setattr(tiering, "_current_rank", lambda: self.rank_domain() or 0)
         resilience.set_quorum_transport(self)
         self._prev_rank_provider = journal.set_rank_provider(
             lambda: self.rank_domain() or 0
@@ -521,8 +587,10 @@ class FleetWorld(LockstepWorld):
     def uninstall(self) -> None:
         from metrics_tpu.observability import journal
         from metrics_tpu.parallel import resilience
+        from metrics_tpu.parallel import tiering
 
         resilience.reset_resilience()
+        tiering.reset_tiering()
         if self._prev_rank_provider is not None:
             journal.set_rank_provider(self._prev_rank_provider)
             self._prev_rank_provider = None

@@ -1,0 +1,588 @@
+"""Two-level (tiered) collective schedule suite (ISSUE 20 tentpole).
+
+The contract under test: with a tier map configured
+(``tiering.set_tier_map`` / ``METRICS_TPU_TIER_SIZE``) and a subset
+transport installed, every bucketed sync runs reduce-within-tier first,
+ONE inter-tier exchange per bucket, then an intra-tier broadcast — and at
+full precision the result is **bit-identical** to today's flat world
+gather for reduce AND cat states, over real :class:`LockstepWorld`
+rendezvous collectives. Quantization (``sync_precision="bf16"/"int8"``)
+engages ONLY the slow hop, only on explicit opt-in, stays within the
+documented tolerance, and is exactly bit-stable run-to-run. Asymmetric
+tier maps and mixed-precision ranks fail loudly and symmetrically through
+the health word's v5 columns (typed :class:`StateDivergenceError` on
+every rank, before any payload moves). FleetWorld rows: a dead rank
+inside a tier shrinks the quorum and renegotiates the topology in the
+same membership epoch; a whole dead tier collapses the layout to the
+degenerate (flat) schedule.
+"""
+import contextlib
+import threading
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.parallel.async_sync as async_mod
+import metrics_tpu.parallel.resilience as resilience
+import metrics_tpu.parallel.sync as sync_mod
+from metrics_tpu.core.cat_buffer import CatBuffer
+from metrics_tpu.core.collections import MetricCollection
+from metrics_tpu.core.metric import Metric
+from metrics_tpu.core.plan import clear_plans, tier_schedule_for
+from metrics_tpu.observability import journal
+from metrics_tpu.observability.trace_export import chrome_trace
+from metrics_tpu.parallel import tiering
+from metrics_tpu.parallel.bucketing import clear_sync_plan_cache
+from metrics_tpu.parallel.health import reset_channel_health
+from metrics_tpu.parallel.quantize import validate_sync_precision
+from metrics_tpu.parallel.sync import host_sync_state
+from metrics_tpu.utils.exceptions import MetricsTPUUserError, StateDivergenceError
+from tests.helpers.fake_world import FaultProfile, FleetWorld, LockstepWorld
+
+pytestmark = pytest.mark.filterwarnings("ignore::UserWarning")
+
+WORLD = 8
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tiering():
+    clear_sync_plan_cache()
+    clear_plans()
+    tiering.reset_tiering()
+    reset_channel_health()
+    journal.clear()
+    yield
+    clear_sync_plan_cache()
+    clear_plans()
+    tiering.reset_tiering()
+    reset_channel_health()
+    journal.disable()
+    journal.clear()
+
+
+@contextlib.contextmanager
+def _lockstep(world=WORLD, tier_size=None):
+    """A LockstepWorld wired over every seam the tiered stack reaches
+    through: the flat gather, the per-rank identity (so each fake rank
+    derives ITS OWN topology view), the async executor lanes, and — when
+    ``tier_size`` is given — the explicit tier map + subset transport."""
+    w = LockstepWorld(world)
+    saved = (
+        jax.process_count,
+        sync_mod._raw_process_allgather,
+        tiering._current_rank,
+        async_mod._get_executor,
+        async_mod._current_domain,
+    )
+    jax.process_count = lambda: world
+    sync_mod._raw_process_allgather = w.allgather
+    tiering._current_rank = lambda: w._rank.value
+    async_mod._get_executor = w.executor_for_current_rank
+    async_mod._current_domain = w.rank_domain
+    if tier_size is not None:
+        tiering.set_tier_map(tier_size)
+        tiering.set_tier_transport(w)
+    try:
+        yield w
+    finally:
+        (
+            jax.process_count,
+            sync_mod._raw_process_allgather,
+            tiering._current_rank,
+            async_mod._get_executor,
+            async_mod._current_domain,
+        ) = saved
+        tiering.reset_tiering()
+        clear_plans()
+        w.shutdown_executors()
+
+
+def _mixed_state(rank: int):
+    """Mixed dtypes/reductions, uneven cat rows and a CatBuffer — every
+    payload class the bucketed engine routes."""
+    buf = CatBuffer(16)
+    buf.append(jnp.arange(2 + rank, dtype=jnp.float32) + 10.0 * rank)
+    state = {
+        "sum_f32": jnp.asarray([[1.5, 2.5]]) * (rank + 1),
+        "sum_i32": jnp.asarray([2, 3], jnp.int32) + rank,
+        "mean_f32": jnp.asarray([0.25, 0.75]) + rank,
+        "max_f32": jnp.asarray(1.0 + 3 * rank),
+        "cat_f32": jnp.arange(3 + rank, dtype=jnp.float32) + 10.0 * rank,
+        "buf": buf,
+    }
+    reductions = {
+        "sum_f32": "sum", "sum_i32": "sum", "mean_f32": "mean",
+        "max_f32": "max", "cat_f32": "cat", "buf": "cat",
+    }
+    return state, reductions
+
+
+def _state_bytes(state):
+    out = {}
+    for name in sorted(state):
+        v = state[name]
+        if isinstance(v, CatBuffer):
+            out[name] = (
+                v.capacity,
+                int(np.asarray(v.count)),
+                np.asarray(v.buffer).tobytes(),
+            )
+        elif isinstance(v, list):
+            out[name] = tuple(np.asarray(x).tobytes() for x in v)
+        else:
+            arr = np.asarray(v)
+            out[name] = (arr.dtype.str, arr.shape, arr.tobytes())
+    return out
+
+
+def _run_sync(tier_size=None, sync_precision=None, world=WORLD):
+    """Drive one host_sync_state round on every rank; returns the per-rank
+    (state bytes, stats dict, rendezvous call count)."""
+    with _lockstep(world, tier_size) as w:
+
+        def body(rank):
+            state, reds = _mixed_state(rank)
+            stats = {}
+            synced = host_sync_state(
+                state, reds, update_count=1, timeout=0, metric_name="tiered",
+                sync_precision=sync_precision, stats=stats,
+            )
+            return _state_bytes(synced), stats, w.calls
+
+        return w.run(body)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: tiered full precision ≡ flat, reduce + cat, real collectives
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("tier_size", [2, 4])
+def test_tiered_full_precision_bit_identical_to_flat(tier_size):
+    flat = _run_sync(tier_size=None)
+    tiered = _run_sync(tier_size=tier_size)
+    for rank in range(WORLD):
+        assert tiered[rank][0] == flat[rank][0], rank
+    # SPMD symmetric: every rank holds the identical synced view
+    assert all(tiered[r][0] == tiered[0][0] for r in range(WORLD))
+    # the slow hop really did shrink: per-rank byte counters populated
+    stats = tiered[0][1]
+    assert stats["intra_tier_bytes"] > 0
+    assert stats["inter_tier_bytes"] > 0
+
+
+def test_tiered_mean_matches_flat_bitwise():
+    """mean routes through sum-of-partials / live-count on both paths —
+    the tiered combine must land on the identical float."""
+    flat = _run_sync(tier_size=None)
+    tiered = _run_sync(tier_size=2)
+    for rank in range(WORLD):
+        assert tiered[rank][0]["mean_f32"] == flat[rank][0]["mean_f32"]
+
+
+def test_flat_world_pays_zero_extra_collectives():
+    """No tier map -> the flat path, same rendezvous count as HEAD; a
+    degenerate map (single tier) must also collapse to exactly that."""
+    flat = _run_sync(tier_size=None)
+    single_tier = _run_sync(tier_size=WORLD)  # one tier == flat world
+    per_rank = _run_sync(tier_size=1)  # one rank per tier == flat world
+    for rank in range(WORLD):
+        assert single_tier[rank][0] == flat[rank][0]
+        assert per_rank[rank][0] == flat[rank][0]
+    assert single_tier[0][2] == flat[0][2]  # identical collective budget
+    assert per_rank[0][2] == flat[0][2]
+    assert "inter_tier_bytes" not in single_tier[0][1]
+
+
+# ---------------------------------------------------------------------------
+# overlapped + grouped paths launch the same tiered schedule
+# ---------------------------------------------------------------------------
+
+
+class _Sum(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.total = self.total + jnp.sum(x)
+        self.count = self.count + jnp.asarray(jnp.size(x), jnp.int32)
+
+    def compute(self):
+        return self.total / self.count
+
+
+def _metric_bytes(m):
+    return tuple(np.asarray(m._state[name]).tobytes() for name in sorted(m._defaults))
+
+
+def _run_overlapped(tier_size):
+    with _lockstep(WORLD, tier_size) as w:
+
+        def body(rank):
+            feed = jnp.asarray([1.0 + rank, 2.0 * (rank + 1)])
+            over = _Sum(sync_timeout=0)
+            block = _Sum(sync_timeout=0)
+            over.update(feed)
+            block.update(feed)
+            block.sync()
+            over.sync(blocking=False)  # overlapped launch rides the same schedule
+            over.sync()
+            bits = (_metric_bytes(over), _metric_bytes(block))
+            over.unsync()
+            block.unsync()
+            return bits
+
+        return w.run(body)
+
+
+@pytest.mark.parametrize("tier_size", [2, 4])
+def test_overlapped_round_bit_identical_tiered_vs_blocking(tier_size):
+    flat = _run_overlapped(None)
+    tiered = _run_overlapped(tier_size)
+    for rank in range(WORLD):
+        over_bits, block_bits = tiered[rank]
+        assert over_bits == block_bits  # overlapped ≡ blocking, tiered
+        assert over_bits == flat[rank][0]  # tiered ≡ flat, bitwise
+
+
+def _run_grouped(tier_size):
+    with _lockstep(WORLD, tier_size) as w:
+
+        def body(rank):
+            mc = MetricCollection({"a": _Sum(sync_timeout=0), "b": _Sum(sync_timeout=0)})
+            mc.update(jnp.asarray([1.0 + rank, 0.5 * rank]))
+            mc.sync()  # ONE fused round for the whole collection
+            bits = tuple(_metric_bytes(m) for m in mc.values())
+            mc.unsync()
+            return bits
+
+        return w.run(body)
+
+
+@pytest.mark.parametrize("tier_size", [2, 4])
+def test_grouped_fused_collection_bit_identical_tiered_vs_flat(tier_size):
+    flat = _run_grouped(None)
+    tiered = _run_grouped(tier_size)
+    for rank in range(WORLD):
+        assert tiered[rank] == flat[rank]
+
+
+# ---------------------------------------------------------------------------
+# quantized slow hop: opt-in only, documented tolerance, bit-stable
+# ---------------------------------------------------------------------------
+
+_FLOAT_KEYS = ("sum_f32", "mean_f32", "max_f32", "cat_f32", "buf")
+
+
+def _as_arrays(bytes_state):
+    """Decode the _state_bytes tuples back to float arrays for allclose."""
+    out = {}
+    for name in _FLOAT_KEYS:
+        entry = bytes_state[name]
+        if name == "buf":
+            out[name] = np.frombuffer(entry[2], np.float32)
+        elif isinstance(entry, tuple) and isinstance(entry[0], bytes):
+            out[name] = np.concatenate([np.frombuffer(b, np.float32) for b in entry])
+        else:
+            out[name] = np.frombuffer(entry[2], np.dtype(entry[0]))
+    return out
+
+
+def test_bf16_slow_hop_within_tolerance_and_bit_stable():
+    flat = _run_sync(tier_size=None)
+    q1 = _run_sync(tier_size=4, sync_precision="bf16")
+    q2 = _run_sync(tier_size=4, sync_precision="bf16")
+    for rank in range(WORLD):
+        # exactly bit-stable run-to-run: deterministic encode/combine order
+        assert q1[rank][0] == q2[rank][0], rank
+        got = _as_arrays(q1[rank][0])
+        want = _as_arrays(flat[rank][0])
+        for name in _FLOAT_KEYS:
+            # documented tolerance: bf16 mantissa (8 bits) -> rtol 2^-7
+            np.testing.assert_allclose(
+                got[name], want[name], rtol=2e-2, atol=1e-6, err_msg=name
+            )
+        # int32 payloads pass through raw even under the precision knob
+        assert q1[rank][0]["sum_i32"] == flat[rank][0]["sum_i32"]
+    assert all(q1[r][0] == q1[0][0] for r in range(WORLD))  # SPMD symmetric
+
+
+def test_int8_slow_hop_within_tolerance_and_bit_stable():
+    flat = _run_sync(tier_size=None)
+    q1 = _run_sync(tier_size=4, sync_precision="int8")
+    q2 = _run_sync(tier_size=4, sync_precision="int8")
+    for rank in range(WORLD):
+        assert q1[rank][0] == q2[rank][0], rank
+        got = _as_arrays(q1[rank][0])
+        want = _as_arrays(flat[rank][0])
+        for name in _FLOAT_KEYS:
+            # documented tolerance: block-scaled int8, 1/127 of block maxabs
+            np.testing.assert_allclose(
+                got[name], want[name], rtol=0.05, atol=0.1, err_msg=name
+            )
+        assert q1[rank][0]["sum_i32"] == flat[rank][0]["sum_i32"]
+
+
+def test_quantization_needs_explicit_opt_in():
+    """No ``sync_precision=`` -> full precision even with tiers configured
+    (bit-identical, covered above); an unknown precision is a loud typed
+    error at construction, not a silent fallback mid-sync."""
+    with pytest.raises(MetricsTPUUserError, match="sync_precision"):
+        validate_sync_precision("fp4")
+    with pytest.raises(MetricsTPUUserError, match="sync_precision"):
+        _Sum(sync_precision="fp4")
+    with pytest.raises(MetricsTPUUserError, match="sync_precision"):
+        MetricCollection({"a": _Sum()}, sync_precision="int4")
+    # "full" is the explicit spelling of the default
+    m = _Sum(sync_precision="full")
+    assert m.sync_precision is None
+
+
+def test_precision_without_tier_map_stays_flat_and_exact():
+    """The knob quantizes ONLY the slow hop; with no tiers there is no
+    slow hop, so results stay bit-identical to the flat gather."""
+    flat = _run_sync(tier_size=None)
+    q = _run_sync(tier_size=None, sync_precision="int8")
+    for rank in range(WORLD):
+        assert q[rank][0] == flat[rank][0]
+
+
+# ---------------------------------------------------------------------------
+# negotiation: asymmetric maps / mixed precision fail loudly + symmetrically
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_precision_ranks_raise_on_every_rank():
+    with _lockstep(4, tier_size=2) as w:
+
+        def body(rank):
+            state = {"s": jnp.asarray(1.0 + rank)}
+            with pytest.raises(StateDivergenceError, match="precision"):
+                host_sync_state(
+                    state, {"s": "sum"}, update_count=1, timeout=0,
+                    sync_precision="bf16" if rank % 2 == 0 else None,
+                )
+            return True
+
+        assert w.run(body) == [True] * 4
+
+
+def test_asymmetric_tier_map_raises_on_every_rank():
+    with _lockstep(WORLD) as w:
+        # ranks < 4 believe tier_size=2, ranks >= 4 believe tier_size=4 —
+        # the health word's tier column catches the split before any
+        # payload collective, on EVERY rank
+        tiering.set_tier_map(lambda r: r // (2 if w._rank.value < 4 else 4))
+        tiering.set_tier_transport(w)
+
+        def body(rank):
+            state = {"s": jnp.asarray(1.0 + rank)}
+            with pytest.raises(StateDivergenceError, match="tier"):
+                host_sync_state(state, {"s": "sum"}, update_count=1, timeout=0)
+            return True
+
+        assert w.run(body) == [True] * WORLD
+
+
+def test_unconfigured_peer_raises_on_every_rank():
+    """One rank with NO tier map against configured peers is the classic
+    deploy skew — must fail typed and symmetric, not deadlock."""
+    with _lockstep(4) as w:
+        tiering.set_tier_map(lambda r: -1 if w._rank.value == 3 else r // 2)
+        tiering.set_tier_transport(w)
+
+        def body(rank):
+            with pytest.raises(StateDivergenceError, match="tier"):
+                host_sync_state(
+                    {"s": jnp.asarray(1.0)}, {"s": "sum"}, update_count=1, timeout=0
+                )
+            return True
+
+        assert w.run(body) == [True] * 4
+
+
+# ---------------------------------------------------------------------------
+# plan layer: one cached schedule per (schema, topology)
+# ---------------------------------------------------------------------------
+
+
+def test_tier_schedule_cached_per_schema_and_topology(monkeypatch):
+    monkeypatch.setattr(jax, "process_count", lambda: 4)
+    monkeypatch.setattr(tiering, "_current_rank", lambda: 0)
+    transport = types.SimpleNamespace(subset_allgather=lambda x, ranks: x)
+    tiering.set_tier_map(2)
+    tiering.set_tier_transport(transport)
+    plan = types.SimpleNamespace(schema_key="k1")
+
+    sched = tier_schedule_for(plan)
+    assert sched is not None
+    assert sched.inter_participants == 2  # tiers, not ranks
+    assert sched.flat_participants == 4
+    assert sched.hops_per_bucket == 3
+    assert tier_schedule_for(plan) is sched  # cached
+    assert tier_schedule_for(types.SimpleNamespace(schema_key="k2")) is not sched
+
+    clear_plans()
+    assert tier_schedule_for(plan) is not sched  # invalidated with the plans
+    assert tier_schedule_for(None) is None
+    tiering.set_tier_map(None)
+    assert tier_schedule_for(plan) is None  # flat world -> no schedule
+
+
+# ---------------------------------------------------------------------------
+# observability: per-hop counters, journal events, trace spans
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_counters_and_hop_events():
+    journal.enable()
+    tiered = _run_sync(tier_size=4)
+    # fleet-wide: the tiered schedule must be a net win over flat gather
+    saved = sum(t[1].get("inter_tier_bytes_saved", 0) for t in tiered)
+    inter = sum(t[1].get("inter_tier_bytes", 0) for t in tiered)
+    intra = sum(t[1].get("intra_tier_bytes", 0) for t in tiered)
+    assert saved > 0 and inter > 0 and intra > 0
+
+    hops = journal.events(kinds=["sync.hop"])
+    assert hops, "tiered sync must journal its hops"
+    assert {e.label for e in hops} == {"intra", "inter"}
+    assert all(e.fields["tier"] >= 0 for e in hops)
+    assert all(e.fields["participants"] >= 1 for e in hops)
+    plans = journal.events(kinds=["plan.tier"])
+    assert plans and plans[0].fields["inter_participants"] == 2  # 8 ranks / tier 4
+    assert plans[0].fields["flat_participants"] == WORLD
+
+    # Chrome-trace export: the two hop classes land on distinguishable spans
+    cats = {ev.get("cat") for ev in chrome_trace()["traceEvents"]}
+    assert "sync-intra-tier" in cats and "sync-inter-tier" in cats
+
+
+def test_metric_surfaces_tier_counters_via_telemetry():
+    with _lockstep(4, tier_size=2) as w:
+
+        def body(rank):
+            m = _Sum(sync_timeout=0)
+            m.update(jnp.asarray([1.0 + rank]))
+            m.sync()
+            stats = m.sync_stats()
+            m.unsync()
+            return stats
+
+        stats = w.run(body)
+    assert sum(s.get("inter_tier_bytes", 0) for s in stats) > 0
+    assert sum(s.get("intra_tier_bytes", 0) for s in stats) > 0
+    assert sum(s.get("inter_tier_bytes_saved", 0) for s in stats) > 0
+
+
+# ---------------------------------------------------------------------------
+# FleetWorld: dead rank inside vs across a tier
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def fleet(monkeypatch):
+    holder = {"world": None}
+
+    def make(world=4, profile=None, tier_size=None, **kwargs):
+        if holder["world"] is not None:
+            holder["world"].uninstall()
+        clear_sync_plan_cache()
+        clear_plans()
+        w = FleetWorld(world, profile, **kwargs)
+        w.install(monkeypatch)
+        if tier_size is not None:
+            tiering.set_tier_map(tier_size)  # transport: the quorum fallback
+        holder["world"] = w
+        return w
+
+    yield make
+    if holder["world"] is not None:
+        holder["world"].uninstall()
+    clear_plans()
+
+
+def _drive_fleet(world, steps):
+    def body(rank):
+        outs = []
+        for step in range(steps):
+            world.begin_round(rank, step)
+            state = {
+                "s": jnp.asarray(float(10 * rank + step)),
+                "c": jnp.arange(1 + rank % 2, dtype=jnp.float32) + rank + step,
+            }
+            synced = host_sync_state(
+                state, {"s": "sum", "c": "cat"}, update_count=1, timeout=0,
+                on_missing="quorum", metric_name="fleet",
+            )
+            outs.append(_state_bytes(synced))
+        topo = tiering.active_topology()
+        layout = None if topo is None else (topo.n_tiers, topo.leaders, topo.live)
+        return outs, resilience.membership_epoch(), resilience.live_ranks(), layout
+
+    return world.run(body)
+
+
+def test_fleet_dead_rank_inside_tier_renegotiates_same_epoch(fleet):
+    """Rank 3 (tier 1) dies: survivors shrink to (0,1,2) in ONE membership
+    transition and the tier map renegotiates in that same epoch — tier 1
+    lives on with its single survivor, leaders recomputed from the
+    survivor set, values bit-equal to a survivors-only reference fleet."""
+    world = fleet(world=4, profile=FaultProfile(preempt_at={3: 1}, tier_size=2),
+                  tier_size=2)
+    results = _drive_fleet(world, 3)
+    assert world.preempted == {3}
+    assert results[3] is None
+
+    for rank in (0, 1, 2):
+        outs, epoch, live, layout = results[rank]
+        assert epoch == 1  # exactly ONE transition: renegotiated in-epoch
+        assert live == (0, 1, 2)
+        assert layout == (2, (0, 2), (0, 1, 2))  # tier 1 = {2}, led by 2
+
+    ref_world = fleet(world=3, tier_size=2)
+    ref = _drive_fleet(ref_world, 3)
+    for rank in (0, 1, 2):
+        for step in (1, 2):  # post-death rounds gather over survivors
+            assert results[rank][0][step] == ref[rank][0][step], (rank, step)
+    assert results[0][0] == results[1][0] == results[2][0]
+
+
+def test_fleet_dead_tier_collapses_to_degenerate_schedule(fleet):
+    """Both ranks of tier 1 die: the surviving layout is a single tier, so
+    the schedule must collapse to the flat (degenerate) path instead of
+    scheduling an inter-tier hop with one participant."""
+    world = fleet(
+        world=4,
+        profile=FaultProfile(preempt_at={2: 1, 3: 1}, tier_size=2),
+        tier_size=2,
+    )
+    results = _drive_fleet(world, 3)
+    assert world.preempted == {2, 3}
+
+    for rank in (0, 1):
+        outs, epoch, live, layout = results[rank]
+        assert live == (0, 1)
+        assert layout is None  # single surviving tier -> degenerate -> flat
+
+    ref_world = fleet(world=2, tier_size=2)
+    ref = _drive_fleet(ref_world, 3)
+    for rank in (0, 1):
+        for step in (1, 2):
+            assert results[rank][0][step] == ref[rank][0][step], (rank, step)
+
+
+def test_fleet_all_live_tiered_bit_identical_to_flat_quorum(fleet):
+    """With everyone alive, the tiered quorum fleet and the flat quorum
+    fleet agree bit-for-bit (the FleetWorld equivalence row)."""
+    tiered_world = fleet(world=4, profile=FaultProfile(tier_size=2), tier_size=2)
+    tiered = _drive_fleet(tiered_world, 2)
+    flat_world = fleet(world=4)
+    flat = _drive_fleet(flat_world, 2)
+    for rank in range(4):
+        assert tiered[rank][0] == flat[rank][0]
+        assert tiered[rank][3] == (2, (0, 2), (0, 1, 2, 3))
